@@ -1,0 +1,28 @@
+"""arroyo-tpu: a TPU-native distributed stream processing framework.
+
+SQL pipelines over unbounded streams with event-time watermarks, windowed
+aggregates/joins lowered to JAX/XLA/Pallas, exactly-once Parquet
+checkpointing, and keyed exchange over TPU ICI collectives. Built new against
+the capabilities of the reference engine surveyed in SURVEY.md.
+"""
+
+__version__ = "0.1.0"
+
+from .batch import Batch, Field, Schema  # noqa: F401
+from .graph import EdgeType, Graph, Node, OpName  # noqa: F401
+from .types import (  # noqa: F401
+    CheckpointBarrier,
+    Signal,
+    SignalKind,
+    TaskInfo,
+    Watermark,
+)
+
+
+def _load_operators() -> None:
+    """Import all operator/connector modules so constructors register."""
+    from . import connectors
+    from .operators import builtin  # noqa: F401
+
+    connectors.load_all()
+    from .windows import tumbling  # noqa: F401

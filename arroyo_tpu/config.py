@@ -1,0 +1,147 @@
+"""Layered configuration.
+
+Mirrors the reference's figment-style loader
+(crates/arroyo-rpc/src/config.rs:29-92: compiled default.toml -> config files
+-> env overrides) with Python's tomllib and ``ARROYO_TPU__SECTION__KEY``
+environment variables. Defaults mirror crates/arroyo-rpc/default.toml.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import threading
+import tomllib
+from typing import Any
+
+_DEFAULTS: dict[str, Any] = {
+    "pipeline": {
+        "source-batch-size": 512,  # default.toml: rows per source flush
+        "source-batch-linger-ms": 100,
+        "update-aggregate-flush-interval-ms": 1000,
+        "allowed-restarts": 20,
+        "healthy-duration-ms": 120_000,
+        "worker-heartbeat-timeout-ms": 30_000,
+        "default-checkpoint-interval-ms": 10_000,
+        "chaining": {"enabled": False},
+        "compaction": {"enabled": False, "checkpoints-to-compact": 4},
+    },
+    "worker": {
+        "queue-size": 8192,  # rows of in-flight budget per input edge
+        "task-slots": 16,
+    },
+    "device": {
+        # TPU runtime knobs (no reference equivalent; this is the jax backend)
+        "enabled": True,  # lower window aggregates to jax when possible
+        "batch-capacity": 8192,  # padded device batch size (rows)
+        "table-capacity": 65536,  # slots in the keyed HBM state table
+        "max-probes": 64,  # linear-probing rounds in the device hash table
+        "emit-capacity": 8192,  # padded rows per window-close extraction
+    },
+    "checkpoint": {
+        "storage-url": "/tmp/arroyo-tpu/checkpoints",
+        "interval-ms": 10_000,
+    },
+    "controller": {
+        "scheduler": "embedded",
+    },
+    "api": {"http-port": 5115},
+    "admin": {"http-port": 5114},
+}
+
+
+class Config:
+    def __init__(self, data: dict[str, Any]):
+        self._data = data
+
+    def get(self, path: str, default=None):
+        """Dotted-path lookup: config().get("worker.queue-size")."""
+        cur: Any = self._data
+        for part in path.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return default
+            cur = cur[part]
+        return cur
+
+    def section(self, name: str) -> dict:
+        return self._data.get(name, {})
+
+    def with_overrides(self, overrides: dict[str, Any]) -> "Config":
+        data = copy.deepcopy(self._data)
+        for path, value in overrides.items():
+            _set_path(data, path, value)
+        return Config(data)
+
+
+def _set_path(data: dict, path: str, value):
+    parts = path.split(".")
+    cur = data
+    for p in parts[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[parts[-1]] = value
+
+
+def _merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _load() -> Config:
+    data = copy.deepcopy(_DEFAULTS)
+    for path in ("/etc/arroyo-tpu/config.toml",
+                 os.path.expanduser("~/.config/arroyo-tpu/config.toml"),
+                 "arroyo-tpu.toml"):
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                data = _merge(data, tomllib.load(f))
+    env_file = os.environ.get("ARROYO_TPU_CONFIG")
+    if env_file and os.path.exists(env_file):
+        with open(env_file, "rb") as f:
+            data = _merge(data, tomllib.load(f))
+    # ARROYO_TPU__WORKER__QUEUE_SIZE=1024 -> worker.queue-size
+    for key, val in os.environ.items():
+        if not key.startswith("ARROYO_TPU__"):
+            continue
+        parts = [p.lower().replace("_", "-") for p in key[len("ARROYO_TPU__"):].split("__")]
+        parsed: Any = val
+        for conv in (int, float):
+            try:
+                parsed = conv(val)
+                break
+            except ValueError:
+                continue
+        if val.lower() in ("true", "false"):
+            parsed = val.lower() == "true"
+        _set_path(data, ".".join(parts), parsed)
+    return Config(data)
+
+
+_lock = threading.Lock()
+_config: Config | None = None
+
+
+def config() -> Config:
+    global _config
+    with _lock:
+        if _config is None:
+            _config = _load()
+        return _config
+
+
+def update(overrides: dict[str, Any]) -> None:
+    """Live-update config (used by tests; reference smoke_tests.rs:46)."""
+    global _config
+    with _lock:
+        base = _config if _config is not None else _load()
+        _config = base.with_overrides(overrides)
+
+
+def reset() -> None:
+    global _config
+    with _lock:
+        _config = None

@@ -1,0 +1,102 @@
+"""Impulse source: synthetic counter stream at a configured rate
+(reference crates/arroyo-connectors/src/impulse/mod.rs:104-183).
+
+Schema: counter uint64, subtask_index uint64, _timestamp. Offsets checkpoint
+into a global-keyed table so restore resumes exactly where the snapshot was
+taken (exactly-once source semantics).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..batch import TIMESTAMP_FIELD, Batch, Field, Schema
+from ..config import config
+from ..operators.base import SourceOperator, TableSpec
+from ..types import SourceFinishType
+from . import register_source
+
+IMPULSE_SCHEMA = Schema.of(
+    [Field("counter", "uint64"), Field("subtask_index", "uint64"), Field(TIMESTAMP_FIELD, "int64")]
+)
+
+
+class ImpulseSource(SourceOperator):
+    """config: event_rate (rows/s, 0 = unthrottled), message_count (per
+    subtask; None = unbounded), interval_micros (event-time step; default
+    derived from event_rate or 1ms), start_time_micros."""
+
+    def __init__(self, cfg: dict):
+        self.event_rate = cfg.get("event_rate", 0)
+        self.message_count = cfg.get("message_count")
+        self.start_time_micros = cfg.get("start_time_micros", int(time.time() * 1e6))
+        if cfg.get("interval_micros") is not None:
+            self.interval_micros = cfg["interval_micros"]
+        elif self.event_rate:
+            self.interval_micros = max(int(1e6 / self.event_rate), 1)
+        else:
+            self.interval_micros = 1000
+
+    def tables(self):
+        return [TableSpec("s", "global_keyed")]
+
+    def run(self, sctx, collector) -> SourceFinishType:
+        ctx = sctx.ctx
+        sub = ctx.task_info.subtask_index
+        tbl = ctx.table_manager.global_keyed("s")
+        counter = tbl.get(sub, 0)
+        batch_size = config().get("pipeline.source-batch-size")
+        rate_per_task = (
+            self.event_rate / ctx.task_info.parallelism if self.event_rate else 0
+        )
+        started = time.monotonic()
+
+        def control() -> Optional[SourceFinishType]:
+            msg = sctx.poll_control()
+            if msg is None:
+                return None
+            if msg.kind == "checkpoint":
+                tbl.insert(sub, counter)
+                sctx.start_checkpoint(msg.barrier)
+                if msg.barrier.then_stop:
+                    return SourceFinishType.FINAL
+            elif msg.kind == "stop":
+                return SourceFinishType.IMMEDIATE
+            return None
+
+        while self.message_count is None or counter < self.message_count:
+            r = control()
+            if r is not None:
+                return r
+            n = batch_size
+            if self.message_count is not None:
+                n = min(n, self.message_count - counter)
+            idx = np.arange(counter, counter + n, dtype=np.uint64)
+            ts = self.start_time_micros + idx.astype(np.int64) * self.interval_micros
+            collector.collect(
+                Batch(
+                    {
+                        "counter": idx,
+                        "subtask_index": np.full(n, sub, dtype=np.uint64),
+                        TIMESTAMP_FIELD: ts,
+                    }
+                )
+            )
+            counter += n
+            if rate_per_task:
+                target = started + counter / rate_per_task
+                while True:
+                    delay = target - time.monotonic()
+                    if delay <= 0:
+                        break
+                    r = control()
+                    if r is not None:
+                        return r
+                    time.sleep(min(delay, 0.05))
+        return SourceFinishType.GRACEFUL
+
+
+register_source("impulse")(ImpulseSource)

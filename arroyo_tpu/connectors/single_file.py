@@ -1,0 +1,118 @@
+"""single_file test connector: deterministic line-delimited JSON file
+source/sink (reference crates/arroyo-connectors/src/single_file — the fixture
+the SQL smoke-test harness is built on, SURVEY §4).
+
+The source checkpoints its line offset; the sink buffers rows in state and
+writes the file contents on checkpoint/close so restores don't duplicate
+output (matching the reference's committing file sink behavior).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..batch import Batch, Schema
+from ..config import config
+from ..formats.json_fmt import JsonDeserializer, serialize_json_lines
+from ..operators.base import Operator, SourceOperator, TableSpec
+from ..types import SourceFinishType
+from . import register_sink, register_source
+
+
+class SingleFileSource(SourceOperator):
+    """config: path, schema: Schema, event_time_field: str|None,
+    bad_data: "fail"|"drop"."""
+
+    def __init__(self, cfg: dict):
+        self.path = cfg["path"]
+        self.schema: Schema = cfg["schema"]
+        self.event_time_field = cfg.get("event_time_field")
+        self.bad_data = cfg.get("bad_data", "fail")
+
+    def tables(self):
+        return [TableSpec("s", "global_keyed")]
+
+    def run(self, sctx, collector) -> SourceFinishType:
+        ctx = sctx.ctx
+        sub = ctx.task_info.subtask_index
+        tbl = ctx.table_manager.global_keyed("s")
+        offset = tbl.get(sub, 0)
+        de = JsonDeserializer(
+            self.schema,
+            batch_size=config().get("pipeline.source-batch-size"),
+            bad_data=self.bad_data,
+            event_time_field=self.event_time_field,
+        )
+        with open(self.path) as f:
+            lines = f.read().splitlines()
+        # deterministic split across subtasks: round-robin by line number
+        p = ctx.task_info.parallelism
+        i = offset
+        my_lines = lines[sub::p]
+        while i < len(my_lines):
+            msg = sctx.poll_control()
+            if msg is not None:
+                if msg.kind == "checkpoint":
+                    b = de.flush()
+                    if b is not None:
+                        collector.collect(b)
+                    tbl.insert(sub, i)
+                    sctx.start_checkpoint(msg.barrier)
+                    if msg.barrier.then_stop:
+                        return SourceFinishType.FINAL
+                elif msg.kind == "stop":
+                    return SourceFinishType.IMMEDIATE
+            line = my_lines[i]
+            i += 1
+            if line.strip():
+                de.deserialize(line)
+            if de.should_flush():
+                b = de.flush()
+                if b is not None:
+                    collector.collect(b)
+        b = de.flush()
+        if b is not None:
+            collector.collect(b)
+        return SourceFinishType.GRACEFUL
+
+
+class SingleFileSink(Operator):
+    """config: path. Buffers emitted lines in a global-keyed state table and
+    rewrites the output file at each checkpoint/close (exactly-once)."""
+
+    def __init__(self, cfg: dict):
+        self.path = cfg["path"]
+        self.lines: list[str] = []
+
+    def tables(self):
+        return [TableSpec("out", "global_keyed")]
+
+    def on_start(self, ctx):
+        tbl = ctx.table_manager.global_keyed("out")
+        self.lines = list(tbl.get(ctx.task_info.subtask_index, []))
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        self.lines.extend(serialize_json_lines(batch))
+
+    def handle_checkpoint(self, barrier, ctx, collector):
+        ctx.table_manager.global_keyed("out").insert(
+            ctx.task_info.subtask_index, list(self.lines)
+        )
+        self._write(ctx)
+
+    def on_close(self, ctx, collector):
+        self._write(ctx)
+
+    def _write(self, ctx):
+        # each subtask appends to its own shard file; parallelism 1 in tests
+        path = self.path
+        if ctx.task_info.parallelism > 1:
+            path = f"{self.path}.{ctx.task_info.subtask_index}"
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            for line in self.lines:
+                f.write(line + "\n")
+
+
+register_source("single_file")(SingleFileSource)
+register_sink("single_file")(SingleFileSink)

@@ -1,0 +1,3 @@
+from .engine import Engine, construct_operator, register_operator, run_graph  # noqa: F401
+from .queues import TaskInbox  # noqa: F401
+from .task import Task, WatermarkHolder  # noqa: F401

@@ -1,0 +1,260 @@
+"""Engine: logical graph -> physical tasks -> running pipeline.
+
+Equivalent of crates/arroyo-worker/src/engine.rs: Program::from_logical (:214,
+node x parallelism -> SubtaskNode; Forward = 1:1 queue, Shuffle/LeftJoin/
+RightJoin = full bipartite queues :319-357), Engine::start (:521), and
+construct_operator (:770-901, OperatorName -> constructor mapping). Single
+process; the multi-host data plane arrives with the C++/DCN runtime, while
+keyed exchange inside a TPU slice is lowered separately (arroyo_tpu.parallel).
+
+The engine also plays the reference controller's checkpoint-coordination role
+for embedded runs (job_controller/mod.rs:325 start_checkpoint,
+checkpoint_state.rs): it injects ControlMessage::Checkpoint into source tasks,
+collects per-subtask checkpoint metadata, and writes the job-level metadata
+marker once every subtask reports.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Callable, Optional
+
+from ..batch import Schema
+from ..config import config
+from ..graph import EdgeType, Graph, Node, OpName
+from ..operators.base import Operator, OperatorContext, SourceOperator
+from ..operators.collector import Collector, OutEdge
+from ..state.tables import (
+    TableManager,
+    latest_complete_checkpoint,
+    write_job_checkpoint_metadata,
+)
+from ..types import CheckpointBarrier, ControlMessage, ControlResp, TaskInfo
+from .queues import TaskInbox
+from .task import Task
+
+# op name -> constructor(node_config, node, subtask ctx...) registered by the
+# operator modules (reference engine.rs:867-879 construct_operator match).
+_CONSTRUCTORS: dict[OpName, Callable[[dict], object]] = {}
+
+
+def register_operator(op: OpName):
+    def deco(fn):
+        _CONSTRUCTORS[op] = fn
+        return fn
+
+    return deco
+
+
+def construct_operator(op: OpName, cfg: dict):
+    if op not in _CONSTRUCTORS:
+        raise ValueError(f"no constructor registered for operator {op}")
+    return _CONSTRUCTORS[op](cfg)
+
+
+class Engine:
+    def __init__(
+        self,
+        graph: Graph,
+        job_id: str = "job",
+        storage_url: Optional[str] = None,
+        restore_epoch: Optional[int] = None,
+    ):
+        self.graph = graph
+        self.job_id = job_id
+        self.storage_url = storage_url or config().get("checkpoint.storage-url")
+        self.restore_epoch = restore_epoch
+        self.resp_queue: "_queue.Queue[ControlResp]" = _queue.Queue()
+        self.tasks: dict[tuple[str, int], Task] = {}
+        self._inboxes: dict[tuple[str, int], TaskInbox] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._finished_tasks: set[tuple[str, int]] = set()
+        self._failed: list[ControlResp] = []
+        self._checkpoints: dict[int, dict[tuple[str, int], dict]] = {}
+        self._completed_epochs: set[int] = set()
+        self._resp_thread: Optional[threading.Thread] = None
+        self._n_tasks = 0
+        self.restored_watermark: Optional[int] = None
+
+    # -------------------------------------------------------------- building
+
+    def build(self) -> None:
+        g = self.graph
+        queue_size = config().get("worker.queue-size")
+        # flat-input layout per node: in-edge order, then upstream subtask
+        in_layout: dict[str, list[tuple[int, int]]] = {}  # node -> [(edge_i, parallelism)]
+        for nid, node in g.nodes.items():
+            edges = g.in_edges(nid)
+            in_layout[nid] = [(i, g.nodes[e.src].parallelism) for i, e in enumerate(edges)]
+            n_inputs = sum(p for _, p in in_layout[nid])
+            for s in range(node.parallelism):
+                if n_inputs:
+                    self._inboxes[(nid, s)] = TaskInbox(n_inputs, queue_size)
+
+        for nid, node in g.nodes.items():
+            in_edges = g.in_edges(nid)
+            n_inputs = sum(g.nodes[e.src].parallelism for e in in_edges)
+
+            def edge_of_input(i, _edges=in_edges, _g=g):
+                base = 0
+                for ei, e in enumerate(_edges):
+                    p = _g.nodes[e.src].parallelism
+                    if i < base + p:
+                        return (ei, i - base)
+                    base += p
+                raise IndexError(i)
+
+            for s in range(node.parallelism):
+                ti = TaskInfo(self.job_id, nid, node.op.value, s, node.parallelism)
+                out_edges = []
+                for e in g.out_edges(nid):
+                    dst_node = g.nodes[e.dst]
+                    # flat input base for this edge at the destination
+                    base = 0
+                    for de in g.in_edges(e.dst):
+                        if de is e:
+                            break
+                        base += g.nodes[de.src].parallelism
+                    dests = [self._inboxes[(e.dst, d)] for d in range(dst_node.parallelism)]
+                    idxs = [base + s] * dst_node.parallelism
+                    etype = e.edge_type
+                    if etype == EdgeType.FORWARD and dst_node.parallelism != node.parallelism:
+                        etype = EdgeType.SHUFFLE
+                    out_edges.append(OutEdge(etype, dests, idxs))
+                collector = Collector(out_edges, s)
+                tm = TableManager(ti, self.storage_url)
+                operator = construct_operator(node.op, node.config)
+                ctx = OperatorContext(
+                    ti,
+                    out_schema=g.out_edges(nid)[0].schema if g.out_edges(nid) else None,
+                    table_manager=tm,
+                    in_edge_of_input=edge_of_input,
+                )
+                if self.restore_epoch is not None:
+                    wm = tm.restore(self.restore_epoch, operator.tables())
+                    if wm is not None:
+                        self.restored_watermark = (
+                            wm if self.restored_watermark is None else min(self.restored_watermark, wm)
+                        )
+                task = Task(
+                    ti,
+                    operator,
+                    self._inboxes.get((nid, s)),
+                    collector,
+                    ctx,
+                    self.resp_queue,
+                    n_inputs=n_inputs,
+                )
+                self.tasks[(nid, s)] = task
+        self._n_tasks = len(self.tasks)
+
+    # -------------------------------------------------------------- running
+
+    def start(self) -> None:
+        if not self.tasks:
+            self.build()
+        self._resp_thread = threading.Thread(target=self._collect_resps, daemon=True)
+        self._resp_thread.start()
+        # start sinks-to-sources so consumers are ready before producers
+        for node in reversed(self.graph.topo_order()):
+            for s in range(node.parallelism):
+                self.tasks[(node.node_id, s)].start()
+
+    def _collect_resps(self) -> None:
+        while True:
+            try:
+                resp = self.resp_queue.get(timeout=0.25)
+            except _queue.Empty:
+                with self._lock:
+                    if len(self._finished_tasks) + len(self._failed) >= self._n_tasks and self._n_tasks:
+                        return
+                continue
+            with self._lock:
+                key = (resp.node_id, resp.subtask_index)
+                if resp.kind == "task_finished":
+                    self._finished_tasks.add(key)
+                elif resp.kind == "task_failed":
+                    self._failed.append(resp)
+                    # propagate: unstick every surviving task so producers
+                    # blocked on a dead consumer's row budget unwind
+                    # (reference: ControlResp::TaskFailed -> controller stops
+                    # the job; here the embedded engine aborts directly)
+                    self._abort()
+                elif resp.kind == "checkpoint_completed":
+                    ep = self._checkpoints.setdefault(resp.epoch, {})
+                    ep[key] = resp.subtask_metadata
+                    if len(ep) == self._n_tasks:
+                        write_job_checkpoint_metadata(
+                            self.storage_url, self.job_id, resp.epoch,
+                            {"operators": list({k[0] for k in ep})},
+                        )
+                        self._completed_epochs.add(resp.epoch)
+                self._cond.notify_all()
+
+    # -------------------------------------------------------------- control
+
+    def source_tasks(self) -> list[Task]:
+        return [t for t in self.tasks.values() if t.is_source]
+
+    def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
+        """Reference job_controller/mod.rs:325: checkpoint starts at sources."""
+        barrier = CheckpointBarrier(epoch=epoch, timestamp=int(time.time() * 1e6), then_stop=then_stop)
+        for t in self.source_tasks():
+            t.control_queue.put(ControlMessage(kind="checkpoint", barrier=barrier))
+
+    def checkpoint_and_wait(self, epoch: int, timeout: float = 60.0, then_stop: bool = False) -> bool:
+        self.trigger_checkpoint(epoch, then_stop=then_stop)
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while epoch not in self._completed_epochs:
+                if self._failed:
+                    raise RuntimeError(f"task failed during checkpoint: {self._failed[0].error}")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=min(remaining, 0.5))
+        return True
+
+    def stop(self) -> None:
+        for t in self.source_tasks():
+            t.control_queue.put(ControlMessage(kind="stop"))
+
+    def _abort(self) -> None:
+        """Hard-stop after a task failure: stop sources and close every
+        inbox so blocked producers/consumers exit."""
+        self.stop()
+        for inbox in self._inboxes.values():
+            inbox.close()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            if self._failed:
+                # give surviving tasks a moment to unwind after the abort
+                for t in self.tasks.values():
+                    t.join(2.0)
+                raise RuntimeError(f"pipeline task failed:\n{self._failed[0].error}")
+            alive = [t for t in self.tasks.values() if t.thread and t.thread.is_alive()]
+            if not alive:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{len(alive)} tasks still running after join timeout"
+                )
+            alive[0].join(0.2)
+        if self._failed:
+            raise RuntimeError(f"pipeline task failed:\n{self._failed[0].error}")
+
+    def run_to_completion(self, timeout: Optional[float] = 120.0) -> None:
+        self.start()
+        self.join(timeout)
+
+
+def run_graph(graph: Graph, job_id: str = "job", timeout: float = 120.0, **kw) -> Engine:
+    """Convenience: build, run to completion, return the finished engine."""
+    eng = Engine(graph, job_id=job_id, **kw)
+    eng.run_to_completion(timeout)
+    return eng

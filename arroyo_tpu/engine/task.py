@@ -1,0 +1,270 @@
+"""Physical subtask run loop.
+
+Equivalent of the reference's operator_run_behavior
+(crates/arroyo-operator/src/operator.rs:863-996): a select-loop over control
+messages, the fused input stream, and a tick interval; handles
+SignalMessage::{Barrier, Watermark, Stop, EndOfData} (:624-676); aligned
+barriers block inputs that already delivered the current epoch's barrier
+(:966-975, CheckpointCounter lib.rs:71); watermark merge is the min over
+per-input watermarks with Idle short-circuit (context.rs:33-84
+WatermarkHolder).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Optional, Union
+
+from ..batch import Batch
+from ..operators.base import Operator, OperatorContext, SourceOperator
+from ..operators.collector import Collector
+from ..types import (
+    CheckpointBarrier,
+    CheckpointEvent,
+    ControlMessage,
+    ControlResp,
+    Signal,
+    SignalKind,
+    SourceFinishType,
+    TaskInfo,
+    Watermark,
+)
+from .queues import TaskInbox
+
+
+class WatermarkHolder:
+    """Min-merge of per-input watermarks (reference context.rs:33-84)."""
+
+    def __init__(self, n_inputs: int):
+        self._wms: dict[int, Optional[Watermark]] = {i: None for i in range(n_inputs)}
+
+    def set(self, input_index: int, wm: Watermark) -> None:
+        if input_index in self._wms:
+            self._wms[input_index] = wm
+
+    def remove(self, input_index: int) -> None:
+        self._wms.pop(input_index, None)
+
+    def merged(self) -> Optional[Watermark]:
+        """None until every live input has reported; Idle only if all idle."""
+        if not self._wms:
+            return None
+        values = list(self._wms.values())
+        if any(v is None for v in values):
+            return None
+        non_idle = [v.value for v in values if not v.is_idle]
+        if not non_idle:
+            return Watermark.idle()
+        return Watermark.event_time(min(non_idle))
+
+
+class SourceContext:
+    """What a SourceOperator.run sees: control polling + checkpoint helper
+    (reference SourceContext / start_checkpoint, operator.rs:313-341)."""
+
+    def __init__(self, task: "Task"):
+        self._task = task
+        self.ctx = task.ctx
+
+    def poll_control(self) -> Optional[ControlMessage]:
+        try:
+            return self._task.control_queue.get_nowait()
+        except _queue.Empty:
+            return None
+
+    def start_checkpoint(self, barrier: CheckpointBarrier) -> None:
+        self._task.run_source_checkpoint(barrier)
+
+
+class Task:
+    def __init__(
+        self,
+        task_info: TaskInfo,
+        operator: Union[Operator, SourceOperator],
+        inbox: Optional[TaskInbox],
+        collector: Collector,
+        ctx: OperatorContext,
+        resp_queue: "_queue.Queue[ControlResp]",
+        n_inputs: int = 0,
+    ):
+        self.task_info = task_info
+        self.operator = operator
+        self.inbox = inbox
+        self.collector = collector
+        self.ctx = ctx
+        self.resp_queue = resp_queue
+        self.n_inputs = n_inputs
+        self.control_queue: "_queue.Queue[ControlMessage]" = _queue.Queue()
+        self.thread: Optional[threading.Thread] = None
+        self.is_source = isinstance(operator, SourceOperator)
+
+    # ------------------------------------------------------------------ API
+
+    def start(self) -> None:
+        name = f"{self.task_info.node_id}-{self.task_info.subtask_index}"
+        self.thread = threading.Thread(target=self._run_guarded, name=name, daemon=True)
+        self.thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self.thread:
+            self.thread.join(timeout)
+
+    def _resp(self, kind: str, **kw) -> None:
+        self.resp_queue.put(
+            ControlResp(kind=kind, node_id=self.task_info.node_id,
+                        subtask_index=self.task_info.subtask_index, **kw)
+        )
+
+    # ------------------------------------------------------------- run loops
+
+    def _run_guarded(self) -> None:
+        try:
+            self._resp("task_started")
+            if self.is_source:
+                self._run_source()
+            else:
+                self._run_operator()
+            self._resp("task_finished")
+        except Exception:
+            self._resp("task_failed", error=traceback.format_exc())
+
+    def _run_source(self) -> None:
+        op: SourceOperator = self.operator  # type: ignore[assignment]
+        op.on_start(self.ctx)
+        sctx = SourceContext(self)
+        finish = op.run(sctx, self.collector)
+        op.on_close(self.ctx, self.collector)
+        if finish == SourceFinishType.GRACEFUL:
+            self.collector.broadcast(Signal.end_of_data())
+        elif finish == SourceFinishType.IMMEDIATE:
+            self.collector.broadcast(Signal.stop())
+        # FINAL: checkpoint-then-stop already broadcast the barrier; end data.
+        if finish == SourceFinishType.FINAL:
+            self.collector.broadcast(Signal.end_of_data())
+
+    def run_source_checkpoint(self, barrier: CheckpointBarrier) -> None:
+        """Checkpoint table state then broadcast the barrier downstream
+        (reference operator.rs:313-341)."""
+        self._resp("checkpoint_event", checkpoint_event=CheckpointEvent(
+            barrier.epoch, self.task_info.node_id, self.task_info.subtask_index,
+            int(time.time() * 1e6), "started_checkpointing"))
+        meta = self.ctx.table_manager.checkpoint(barrier.epoch, self.ctx.watermark())
+        self.collector.broadcast(Signal.barrier_of(barrier))
+        self._resp("checkpoint_completed", epoch=barrier.epoch, subtask_metadata=meta)
+
+    def _run_operator(self) -> None:
+        op: Operator = self.operator  # type: ignore[assignment]
+        op.on_start(self.ctx)
+        holder = WatermarkHolder(self.n_inputs)
+        finished: set[int] = set()
+        blocked: set[int] = set()
+        held: dict[int, deque] = {}
+        barrier_inputs: set[int] = set()
+        current_barrier: Optional[CheckpointBarrier] = None
+        pending: deque[tuple[int, Union[Batch, Signal]]] = deque()
+        last_merged: Optional[Watermark] = None
+        stopping = False
+
+        tick_us = op.tick_interval_micros()
+        tick_s = tick_us / 1e6 if tick_us else None
+        last_tick = time.monotonic()
+
+        def merged_watermark_changed():
+            nonlocal last_merged
+            merged = holder.merged()
+            if merged is not None and merged != last_merged:
+                last_merged = merged
+                self.ctx.last_watermark = merged
+                out = op.handle_watermark(merged, self.ctx, self.collector)
+                if out is not None:
+                    self.collector.broadcast(Signal.watermark_of(out))
+
+        def run_checkpoint(b: CheckpointBarrier):
+            self._resp("checkpoint_event", checkpoint_event=CheckpointEvent(
+                b.epoch, self.task_info.node_id, self.task_info.subtask_index,
+                int(time.time() * 1e6), "started_checkpointing"))
+            op.handle_checkpoint(b, self.ctx, self.collector)
+            meta = self.ctx.table_manager.checkpoint(b.epoch, self.ctx.watermark())
+            self.collector.broadcast(Signal.barrier_of(b))
+            self._resp("checkpoint_completed", epoch=b.epoch, subtask_metadata=meta)
+
+        def try_complete_alignment():
+            """If every live input delivered the barrier, checkpoint and
+            unblock held inputs; honors checkpoint-then-stop."""
+            nonlocal current_barrier, stopping
+            if current_barrier is None:
+                return
+            live = set(range(self.n_inputs)) - finished
+            if live <= barrier_inputs:
+                run_checkpoint(current_barrier)
+                if current_barrier.then_stop:
+                    stopping = True
+                current_barrier = None
+                barrier_inputs.clear()
+                blocked.clear()
+                # drain held items back through the loop, preserving
+                # per-input order (budget released as they process)
+                for i in sorted(held):
+                    pending.extend(held[i])
+                held.clear()
+
+        while True:
+            if pending:
+                idx, item = pending.popleft()
+            else:
+                timeout = 0.5
+                if tick_s is not None:
+                    timeout = min(timeout, max(tick_s - (time.monotonic() - last_tick), 0.0))
+                got = self.inbox.get(timeout=timeout) if self.inbox else None
+                if got is None:
+                    if self.inbox is not None and self.inbox.closed:
+                        return  # engine aborted the pipeline
+                    if tick_s is not None and time.monotonic() - last_tick >= tick_s:
+                        op.handle_tick(self.ctx, self.collector)
+                        last_tick = time.monotonic()
+                    if self.n_inputs == 0 or len(finished) == self.n_inputs:
+                        break
+                    continue
+                idx, item = got
+            if idx in blocked:
+                held.setdefault(idx, deque()).append((idx, item))
+                continue
+
+            if isinstance(item, Batch):
+                op.process_batch(item, self.ctx, self.collector, input_index=idx)
+                self.inbox.release(idx, item)
+                continue
+
+            sig: Signal = item
+            if sig.kind == SignalKind.WATERMARK:
+                holder.set(idx, sig.watermark)
+                merged_watermark_changed()
+            elif sig.kind == SignalKind.BARRIER:
+                b = sig.barrier
+                if current_barrier is None:
+                    current_barrier = b
+                    self._resp("checkpoint_event", checkpoint_event=CheckpointEvent(
+                        b.epoch, self.task_info.node_id, self.task_info.subtask_index,
+                        int(time.time() * 1e6), "started_alignment"))
+                barrier_inputs.add(idx)
+                blocked.add(idx)
+                try_complete_alignment()
+            elif sig.kind == SignalKind.END_OF_DATA:
+                finished.add(idx)
+                holder.remove(idx)
+                merged_watermark_changed()
+                if len(finished) == self.n_inputs:
+                    op.on_close(self.ctx, self.collector)
+                    self.collector.broadcast(Signal.end_of_data())
+                    break
+                # a pending alignment may now be complete
+                try_complete_alignment()
+            elif sig.kind == SignalKind.STOP:
+                self.collector.broadcast(Signal.stop())
+                break
+            if stopping and not pending:
+                break

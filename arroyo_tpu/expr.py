@@ -1,0 +1,396 @@
+"""Scalar expression engine.
+
+Replaces the reference's DataFusion physical-expression evaluation (the
+deserialized exec plans of crates/arroyo-planner/src/physical.rs) with a small
+AST that evaluates two ways:
+
+  - ``eval_np(cols, n)``  — vectorized NumPy on host batches (sources, formats,
+    watermark generators, key calculation).
+  - ``eval_jnp(cols)``    — jax.numpy under ``jit``; used inside the device
+    window/aggregate step functions so projections and filters fuse with the
+    Pallas/XLA reduction kernels (XLA op fusion plays the role of the
+    reference's operator chaining for expressions).
+
+The SQL planner (arroyo_tpu.sql) compiles parsed SQL scalar expressions into
+these nodes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+
+class Expr:
+    """Base scalar expression node."""
+
+    def eval_np(self, cols: dict[str, np.ndarray], n: int):
+        raise NotImplementedError
+
+    def eval_jnp(self, cols: dict[str, Any]):
+        raise NotImplementedError
+
+    def columns(self) -> set[str]:
+        """Set of input column names referenced."""
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class Col(Expr):
+    name: str
+
+    def eval_np(self, cols, n):
+        return cols[self.name]
+
+    def eval_jnp(self, cols):
+        return cols[self.name]
+
+    def columns(self):
+        return {self.name}
+
+    def __repr__(self):
+        return f"Col({self.name})"
+
+
+@dataclass(frozen=True)
+class Lit(Expr):
+    value: Any  # python scalar (int/float/str/bool/None)
+
+    def eval_np(self, cols, n):
+        return self.value
+
+    def eval_jnp(self, cols):
+        return self.value
+
+    def columns(self):
+        return set()
+
+    def __repr__(self):
+        return f"Lit({self.value!r})"
+
+
+_NP_BINOPS: dict[str, Callable] = {
+    "+": np.add,
+    "-": np.subtract,
+    "*": np.multiply,
+    "/": np.divide,
+    "%": np.mod,
+    "==": np.equal,
+    "!=": np.not_equal,
+    "<": np.less,
+    "<=": np.less_equal,
+    ">": np.greater,
+    ">=": np.greater_equal,
+    "and": np.logical_and,
+    "or": np.logical_or,
+}
+
+
+def _div(a, b):
+    # SQL integer division truncates toward zero; numpy // floors.
+    if _is_integer(a) and _is_integer(b):
+        q = np.floor_divide(a, b)
+        r = np.mod(a, b)
+        # correct floor -> trunc for mixed signs
+        adjust = (r != 0) & ((np.sign(a if np.ndim(a) else np.asarray(a)) < 0) != (np.sign(b if np.ndim(b) else np.asarray(b)) < 0))
+        return q + adjust
+    return np.divide(a, b)
+
+
+def _is_integer(x) -> bool:
+    if isinstance(x, (bool, np.bool_)):
+        return False
+    if isinstance(x, (int, np.integer)):
+        return True
+    return hasattr(x, "dtype") and x.dtype.kind in "iu"
+
+
+def _div_jnp(a, b):
+    """SQL division on device: truncating for integers (lax.div), true
+    division otherwise — matches the numpy path's _div semantics."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if _is_integer(a) and _is_integer(b):
+        a, b = jnp.asarray(a), jnp.asarray(b)
+        common = jnp.promote_types(a.dtype, b.dtype)
+        return lax.div(a.astype(common), b.astype(common))
+    return jnp.divide(a, b)
+
+
+@dataclass(frozen=True)
+class BinOp(Expr):
+    op: str
+    left: Expr
+    right: Expr
+
+    def eval_np(self, cols, n):
+        l = self.left.eval_np(cols, n)
+        r = self.right.eval_np(cols, n)
+        if self.op == "/":
+            return _div(l, r)
+        if self.op in ("==", "!=") and (_is_str(l) or _is_str(r)):
+            l, r = _as_obj(l, n), _as_obj(r, n)
+        return _NP_BINOPS[self.op](l, r)
+
+    def eval_jnp(self, cols):
+        import jax.numpy as jnp
+
+        l = self.left.eval_jnp(cols)
+        r = self.right.eval_jnp(cols)
+        return {
+            "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
+            "/": _div_jnp,
+            "%": jnp.mod,
+            "==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+            "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+            ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+            "and": jnp.logical_and, "or": jnp.logical_or,
+        }[self.op](l, r)
+
+    def columns(self):
+        return self.left.columns() | self.right.columns()
+
+
+def _is_str(x) -> bool:
+    return isinstance(x, str) or (hasattr(x, "dtype") and x.dtype == object)
+
+
+def _as_obj(x, n):
+    if isinstance(x, str) or not hasattr(x, "dtype"):
+        return np.full(n, x, dtype=object)
+    return x
+
+
+@dataclass(frozen=True)
+class Not(Expr):
+    inner: Expr
+
+    def eval_np(self, cols, n):
+        return np.logical_not(self.inner.eval_np(cols, n))
+
+    def eval_jnp(self, cols):
+        import jax.numpy as jnp
+
+        return jnp.logical_not(self.inner.eval_jnp(cols))
+
+    def columns(self):
+        return self.inner.columns()
+
+
+@dataclass(frozen=True)
+class Neg(Expr):
+    inner: Expr
+
+    def eval_np(self, cols, n):
+        return np.negative(self.inner.eval_np(cols, n))
+
+    def eval_jnp(self, cols):
+        return -self.inner.eval_jnp(cols)
+
+    def columns(self):
+        return self.inner.columns()
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    inner: Expr
+    dtype: str  # Schema dtype string
+
+    def eval_np(self, cols, n):
+        v = self.inner.eval_np(cols, n)
+        if self.dtype == "string":
+            v = np.asarray(v) if hasattr(v, "dtype") else np.full(n, v)
+            return np.array([str(x) for x in v], dtype=object)
+        target = {"int32": np.int32, "int64": np.int64, "uint64": np.uint64,
+                  "float32": np.float32, "float64": np.float64, "bool": np.bool_}[self.dtype]
+        if hasattr(v, "dtype") and v.dtype == object:
+            if target in (np.float32, np.float64):
+                return np.array([float(x) for x in v], dtype=target)
+            return np.array([int(x) for x in v], dtype=target)
+        return np.asarray(v).astype(target) if hasattr(v, "dtype") else target(v)
+
+    def eval_jnp(self, cols):
+        import jax.numpy as jnp
+
+        v = self.inner.eval_jnp(cols)
+        target = {"int32": jnp.int32, "int64": jnp.int64, "uint64": jnp.uint64,
+                  "float32": jnp.float32, "float64": jnp.float64, "bool": jnp.bool_}[self.dtype]
+        return jnp.asarray(v).astype(target)
+
+    def columns(self):
+        return self.inner.columns()
+
+
+@dataclass(frozen=True)
+class Case(Expr):
+    """CASE WHEN c1 THEN v1 [WHEN ...] ELSE velse END."""
+
+    branches: tuple[tuple[Expr, Expr], ...]
+    otherwise: Optional[Expr]
+
+    def eval_np(self, cols, n):
+        result = None
+        assigned = np.zeros(n, dtype=bool)
+        for cond, val in self.branches:
+            c = np.broadcast_to(np.asarray(cond.eval_np(cols, n)), (n,))
+            v = val.eval_np(cols, n)
+            v = np.broadcast_to(np.asarray(v), (n,)) if not _is_scalar(v) or True else v
+            sel = c & ~assigned
+            if result is None:
+                result = np.array(v, copy=True) if hasattr(v, "dtype") else np.full(n, v)
+            result = np.where(sel, v, result)
+            assigned |= c
+        if self.otherwise is not None:
+            v = self.otherwise.eval_np(cols, n)
+            v = np.broadcast_to(np.asarray(v), (n,))
+            result = np.where(~assigned, v, result) if result is not None else v
+        return result
+
+    def eval_jnp(self, cols):
+        import jax.numpy as jnp
+
+        result = self.otherwise.eval_jnp(cols) if self.otherwise is not None else jnp.nan
+        for cond, val in reversed(self.branches):
+            result = jnp.where(cond.eval_jnp(cols), val.eval_jnp(cols), result)
+        return result
+
+    def columns(self):
+        out = set()
+        for c, v in self.branches:
+            out |= c.columns() | v.columns()
+        if self.otherwise:
+            out |= self.otherwise.columns()
+        return out
+
+
+def _is_scalar(v):
+    return not hasattr(v, "shape") or v.shape == ()
+
+
+def _np_concat(args, n):
+    parts = [_as_obj(a if _is_str(a) else np.asarray(a), n) for a in args]
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = "".join(str(p[i]) for p in parts)
+    return out
+
+
+@dataclass(frozen=True)
+class Func(Expr):
+    """Scalar function call."""
+
+    name: str  # lowercase
+    args: tuple[Expr, ...]
+
+    def eval_np(self, cols, n):
+        a = [arg.eval_np(cols, n) for arg in self.args]
+        name = self.name
+        if name == "abs":
+            return np.abs(a[0])
+        if name == "round":
+            return np.round(a[0], int(a[1]) if len(a) > 1 else 0)
+        if name == "floor":
+            return np.floor(a[0])
+        if name == "ceil":
+            return np.ceil(a[0])
+        if name == "sqrt":
+            return np.sqrt(a[0])
+        if name == "power":
+            return np.power(a[0], a[1])
+        if name == "ln":
+            return np.log(a[0])
+        if name == "log10":
+            return np.log10(a[0])
+        if name == "exp":
+            return np.exp(a[0])
+        if name == "coalesce":
+            out = _as_obj(a[0], n).copy() if _is_str(a[0]) else np.array(np.broadcast_to(np.asarray(a[0]), (n,)), copy=True)
+            for alt in a[1:]:
+                isnull = _null_mask(out)
+                alt_b = np.broadcast_to(np.asarray(alt), (n,))
+                out = np.where(isnull, alt_b, out)
+            return out
+        if name == "concat":
+            return _np_concat(a, n)
+        if name == "lower":
+            return np.array([s.lower() if s is not None else None for s in _as_obj(a[0], n)], dtype=object)
+        if name == "upper":
+            return np.array([s.upper() if s is not None else None for s in _as_obj(a[0], n)], dtype=object)
+        if name in ("length", "char_length", "character_length"):
+            return np.array([len(s) if s is not None else 0 for s in _as_obj(a[0], n)], dtype=np.int64)
+        if name == "substring" or name == "substr":
+            start = np.broadcast_to(np.asarray(a[1]), (n,))
+            if len(a) > 2:
+                ln = np.broadcast_to(np.asarray(a[2]), (n,))
+                return np.array([s[max(int(st) - 1, 0):max(int(st) - 1, 0) + int(l)] if s is not None else None
+                                 for s, st, l in zip(_as_obj(a[0], n), start, ln)], dtype=object)
+            return np.array([s[max(int(st) - 1, 0):] if s is not None else None
+                             for s, st in zip(_as_obj(a[0], n), start)], dtype=object)
+        if name == "md5":
+            import hashlib as _h
+            return np.array([_h.md5(str(s).encode()).hexdigest() for s in _as_obj(a[0], n)], dtype=object)
+        if name == "hash":
+            from .hashing import hash_columns
+            return hash_columns([np.broadcast_to(np.asarray(x), (n,)) for x in a])
+        if name == "extract_epoch":  # seconds since epoch from micros timestamp
+            return np.asarray(a[0]) // 1_000_000
+        if name == "date_trunc_micros":  # (granularity_micros, ts)
+            g = int(a[0]) if _is_scalar(a[0]) else a[0]
+            return (np.asarray(a[1]) // g) * g
+        if name == "to_timestamp_micros":
+            return np.asarray(a[0]).astype(np.int64)
+        if name == "is_null":
+            return _null_mask(_as_obj(a[0], n) if _is_str(a[0]) else np.broadcast_to(np.asarray(a[0]), (n,)))
+        if name == "is_not_null":
+            return ~_null_mask(_as_obj(a[0], n) if _is_str(a[0]) else np.broadcast_to(np.asarray(a[0]), (n,)))
+        raise NotImplementedError(f"scalar function {name}")
+
+    def eval_jnp(self, cols):
+        import jax.numpy as jnp
+
+        a = [arg.eval_jnp(cols) for arg in self.args]
+        name = self.name
+        table = {
+            "abs": jnp.abs, "floor": jnp.floor, "ceil": jnp.ceil, "sqrt": jnp.sqrt,
+            "ln": jnp.log, "log10": jnp.log10, "exp": jnp.exp,
+        }
+        if name in table:
+            return table[name](a[0])
+        if name == "round":
+            return jnp.round(a[0], int(self.args[1].value) if len(a) > 1 else 0)
+        if name == "power":
+            return jnp.power(a[0], a[1])
+        if name == "extract_epoch":
+            return a[0] // 1_000_000
+        if name == "date_trunc_micros":
+            return (a[1] // a[0]) * a[0]
+        raise NotImplementedError(f"device scalar function {name}")
+
+    def columns(self):
+        out = set()
+        for arg in self.args:
+            out |= arg.columns()
+        return out
+
+
+def _null_mask(arr) -> np.ndarray:
+    if hasattr(arr, "dtype") and arr.dtype == object:
+        return np.array([x is None for x in arr], dtype=bool)
+    if hasattr(arr, "dtype") and arr.dtype.kind == "f":
+        return np.isnan(arr)
+    return np.zeros(len(arr), dtype=bool)
+
+
+def eval_expr(expr: Expr, batch_cols: dict[str, np.ndarray], n: int) -> np.ndarray:
+    """Evaluate to a full-length ndarray (broadcasting scalars)."""
+    v = expr.eval_np(batch_cols, n)
+    if _is_scalar(v) or (hasattr(v, "shape") and v.shape == ()):
+        if isinstance(v, str) or v is None:
+            out = np.empty(n, dtype=object)
+            out[:] = v
+            return out
+        return np.full(n, v)
+    return np.asarray(v)

@@ -1,0 +1,182 @@
+"""Logical dataflow IR.
+
+Equivalent of the reference's LogicalProgram
+(crates/arroyo-datastream/src/logical.rs:299 — petgraph DiGraph<LogicalNode,
+LogicalEdge>, OperatorName :28-43, LogicalEdgeType :46-51) with JSON (not
+protobuf) serialization. Node configs are plain dicts; the SQL planner fills
+them and the worker engine's construct_operator maps op_name -> operator class
+(reference engine.rs:867-879).
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from .batch import Schema
+
+
+class OpName(enum.Enum):
+    """Mirrors reference OperatorName (logical.rs:28-43)."""
+
+    SOURCE = "source"
+    SINK = "sink"
+    VALUE = "value"  # projection/filter (ArrowValue)
+    KEY = "key"  # key calculation (ArrowKey)
+    WATERMARK = "watermark"  # ExpressionWatermark
+    TUMBLING_AGGREGATE = "tumbling_aggregate"
+    SLIDING_AGGREGATE = "sliding_aggregate"
+    SESSION_AGGREGATE = "session_aggregate"
+    UPDATING_AGGREGATE = "updating_aggregate"
+    JOIN_WITH_EXPIRATION = "join_with_expiration"  # updating join
+    INSTANT_JOIN = "instant_join"  # windowed join
+    LOOKUP_JOIN = "lookup_join"
+    WINDOW_FUNCTION = "window_function"  # SQL OVER
+    ASYNC_UDF = "async_udf"
+
+
+class EdgeType(enum.Enum):
+    """Mirrors reference LogicalEdgeType (logical.rs:46-51)."""
+
+    FORWARD = "forward"
+    SHUFFLE = "shuffle"
+    LEFT_JOIN = "left_join"
+    RIGHT_JOIN = "right_join"
+
+
+@dataclass
+class Node:
+    node_id: str
+    op: OpName
+    config: dict
+    parallelism: int = 1
+    description: str = ""
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    edge_type: EdgeType
+    schema: Schema
+
+
+class Graph:
+    """Small DAG container (adjacency-list petgraph stand-in)."""
+
+    def __init__(self):
+        self.nodes: dict[str, Node] = {}
+        self.edges: list[Edge] = []
+
+    def add_node(self, node: Node) -> Node:
+        if node.node_id in self.nodes:
+            raise ValueError(f"duplicate node {node.node_id}")
+        self.nodes[node.node_id] = node
+        return node
+
+    def add_edge(self, src: str, dst: str, edge_type: EdgeType, schema: Schema) -> Edge:
+        for nid in (src, dst):
+            if nid not in self.nodes:
+                raise ValueError(f"unknown node {nid}")
+        e = Edge(src, dst, edge_type, schema)
+        self.edges.append(e)
+        return e
+
+    def in_edges(self, node_id: str) -> list[Edge]:
+        return [e for e in self.edges if e.dst == node_id]
+
+    def out_edges(self, node_id: str) -> list[Edge]:
+        return [e for e in self.edges if e.src == node_id]
+
+    def sources(self) -> list[Node]:
+        return [n for n in self.nodes.values() if not self.in_edges(n.node_id)]
+
+    def sinks(self) -> list[Node]:
+        return [n for n in self.nodes.values() if not self.out_edges(n.node_id)]
+
+    def topo_order(self) -> list[Node]:
+        indeg = {nid: len(self.in_edges(nid)) for nid in self.nodes}
+        ready = sorted([nid for nid, d in indeg.items() if d == 0])
+        out: list[Node] = []
+        while ready:
+            nid = ready.pop(0)
+            out.append(self.nodes[nid])
+            for e in self.out_edges(nid):
+                indeg[e.dst] -= 1
+                if indeg[e.dst] == 0:
+                    ready.append(e.dst)
+        if len(out) != len(self.nodes):
+            raise ValueError("graph has a cycle")
+        return out
+
+    # -- serialization ------------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "nodes": [
+                {
+                    "node_id": n.node_id,
+                    "op": n.op.value,
+                    "config": _jsonable(n.config),
+                    "parallelism": n.parallelism,
+                    "description": n.description,
+                }
+                for n in self.nodes.values()
+            ],
+            "edges": [
+                {
+                    "src": e.src,
+                    "dst": e.dst,
+                    "edge_type": e.edge_type.value,
+                    "schema": e.schema.to_json(),
+                }
+                for e in self.edges
+            ],
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "Graph":
+        g = Graph()
+        for nd in d["nodes"]:
+            g.add_node(
+                Node(nd["node_id"], OpName(nd["op"]), nd["config"], nd["parallelism"], nd.get("description", ""))
+            )
+        for ed in d["edges"]:
+            g.add_edge(ed["src"], ed["dst"], EdgeType(ed["edge_type"]), Schema.from_json(ed["schema"]))
+        return g
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_json())
+
+    @staticmethod
+    def loads(s: str) -> "Graph":
+        return Graph.from_json(json.loads(s))
+
+    def dot(self) -> str:
+        """Graphviz rendering (stands in for `arroyo visualize`, main.rs:492)."""
+        lines = ["digraph pipeline {"]
+        for n in self.nodes.values():
+            lines.append(f'  "{n.node_id}" [label="{n.op.value}\\np={n.parallelism}\\n{n.description}"];')
+        for e in self.edges:
+            lines.append(f'  "{e.src}" -> "{e.dst}" [label="{e.edge_type.value}"];')
+        lines.append("}")
+        return "\n".join(lines)
+
+
+def _jsonable(obj):
+    """Best-effort conversion of node configs to JSON-safe values.
+
+    Expression ASTs inside configs are kept as repr strings for display; the
+    planner keeps the live objects on the in-memory graph it hands the engine.
+    """
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    if isinstance(obj, Schema):
+        return obj.to_json()
+    return repr(obj)

@@ -1,0 +1,83 @@
+"""Deterministic 64-bit key hashing (host, vectorized).
+
+The reference hashes routing keys with ahash via DataFusion's
+``hash_utils::create_hashes`` (crates/arroyo-operator/src/context.rs:512) and
+maps the u64 hash space onto subtasks with ``server_for_hash``
+(crates/arroyo-types/src/lib.rs:621). Here we use a splitmix64-based mix that
+is (a) deterministic across runs/processes (ahash is seeded per-process; our
+checkpoint-rescale story needs stability), (b) vectorizable with NumPy uint64
+lanes, and (c) cheap to recompute on restore.
+
+String columns are hashed via per-unique blake2b (uniques are few relative to
+rows in keyed streams; the unique pass also provides dictionary encoding).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+_C1 = np.uint64(0x9E3779B97F4A7C15)
+_C2 = np.uint64(0xBF58476D1CE4E5B9)
+_C3 = np.uint64(0x94D049BB133111EB)
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 arrays."""
+    z = x + _C1
+    z = (z ^ (z >> np.uint64(30))) * _C2
+    z = (z ^ (z >> np.uint64(27))) * _C3
+    return z ^ (z >> np.uint64(31))
+
+
+_NULL_HASH = np.uint64(0x6E756C6C6E756C6C)  # fixed hash for None entries
+
+
+def _hash_string_array(col: np.ndarray) -> np.ndarray:
+    # pandas.factorize is hash-based (no sort), so it tolerates None mixed
+    # with str (np.unique would raise on the comparison)
+    import pandas as pd
+
+    codes, uniques = pd.factorize(col, use_na_sentinel=True)
+    hashes = np.empty(len(uniques) + 1, dtype=np.uint64)
+    for i, s in enumerate(uniques):
+        b = s.encode("utf-8") if isinstance(s, str) else bytes(s)
+        hashes[i] = np.uint64(
+            int.from_bytes(hashlib.blake2b(b, digest_size=8).digest(), "little")
+        )
+    hashes[-1] = _NULL_HASH  # codes of -1 (None) index the last slot
+    return hashes[codes]
+
+
+def hash_column(col: np.ndarray) -> np.ndarray:
+    """64-bit hash of one column."""
+    if col.dtype == object:
+        return splitmix64(_hash_string_array(col))
+    if col.dtype == np.bool_:
+        col = col.astype(np.uint64)
+    if col.dtype.kind == "f":
+        # canonicalize -0.0 and hash the bit pattern
+        col = np.where(col == 0.0, 0.0, col)
+        col = col.astype(np.float64).view(np.uint64)
+    else:
+        col = col.astype(np.int64).view(np.uint64)
+    return splitmix64(col)
+
+
+def hash_columns(cols: list[np.ndarray]) -> np.ndarray:
+    """Combined 64-bit hash of several columns (row-wise)."""
+    if not cols:
+        raise ValueError("need at least one key column")
+    h = hash_column(cols[0])
+    for c in cols[1:]:
+        h = splitmix64(h ^ (hash_column(c) + _C1))
+    return h
+
+
+def servers_for_hashes(hashes: np.ndarray, n: int) -> np.ndarray:
+    """Vectorized server_for_hash (reference arroyo-types/src/lib.rs:621)."""
+    if n == 1:
+        return np.zeros(len(hashes), dtype=np.int64)
+    size = np.uint64(((1 << 64) - 1) // n + 1)
+    return np.minimum(hashes // size, np.uint64(n - 1)).astype(np.int64)

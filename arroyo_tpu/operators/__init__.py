@@ -1,0 +1,2 @@
+from .base import Operator, OperatorContext, SourceOperator, TableSpec  # noqa: F401
+from .collector import Collector, OutEdge  # noqa: F401

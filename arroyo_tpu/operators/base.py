@@ -1,0 +1,139 @@
+"""Operator trait boundary.
+
+TPU-native equivalent of the reference's operator layer
+(crates/arroyo-operator/src/operator.rs — ArrowOperator :1074, SourceOperator
+:294, OperatorConstructor :55). Operators consume/produce columnar Batches;
+window/join operator bodies dispatch into the jax runtime (arroyo_tpu.ops)
+instead of DataFusion exec plans.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Optional
+
+from ..batch import Batch, Schema
+from ..types import (
+    CheckpointBarrier,
+    SourceFinishType,
+    TaskInfo,
+    Watermark,
+)
+
+if TYPE_CHECKING:
+    from ..state.tables import TableManager
+    from .collector import Collector
+
+
+@dataclass
+class TableSpec:
+    """Declares a state table (reference operator.rs:1077 tables())."""
+
+    name: str
+    kind: str  # "global_keyed" | "expiring_time_key" | "key_time"
+    retention_micros: int = 0
+    schema: Optional[Schema] = None
+
+
+class OperatorContext:
+    """Per-subtask context handed to operator hooks
+    (reference: arroyo-operator/src/context.rs OperatorContext)."""
+
+    def __init__(
+        self,
+        task_info: TaskInfo,
+        out_schema: Optional[Schema],
+        table_manager: "TableManager",
+        in_edge_of_input=None,
+    ):
+        self.task_info = task_info
+        self.out_schema = out_schema
+        self.table_manager = table_manager
+        self.last_watermark: Optional[Watermark] = None
+        # maps flat input index -> (edge_index, upstream_subtask)
+        self._in_edge_of_input = in_edge_of_input or (lambda i: (0, i))
+
+    def edge_of_input(self, input_index: int) -> int:
+        return self._in_edge_of_input(input_index)[0]
+
+    def watermark(self) -> Optional[int]:
+        """Current event-time watermark in micros (None if idle/unset)."""
+        if self.last_watermark is None:
+            return None
+        return self.last_watermark.value
+
+
+class Operator:
+    """Mid-pipeline operator (reference ArrowOperator, operator.rs:1074-1183).
+
+    Hooks are called from the task run loop (engine/task.py) which owns
+    barrier alignment, watermark merging, and end-of-data accounting.
+    """
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def tables(self) -> list[TableSpec]:
+        return []
+
+    def on_start(self, ctx: OperatorContext) -> None:
+        pass
+
+    def process_batch(
+        self, batch: Batch, ctx: OperatorContext, collector: "Collector", input_index: int = 0
+    ) -> None:
+        raise NotImplementedError
+
+    def handle_watermark(
+        self, watermark: Watermark, ctx: OperatorContext, collector: "Collector"
+    ) -> Optional[Watermark]:
+        """Return the watermark to forward downstream, or None to hold it
+        (reference operator.rs:1138)."""
+        return watermark
+
+    def handle_checkpoint(
+        self, barrier: CheckpointBarrier, ctx: OperatorContext, collector: "Collector"
+    ) -> None:
+        """Flush in-flight device/host state into state tables before the
+        table manager snapshots them (reference operator.rs handle_checkpoint)."""
+
+    def handle_commit(self, epoch: int, ctx: OperatorContext) -> None:
+        pass
+
+    def is_committing(self) -> bool:
+        return False
+
+    def tick_interval_micros(self) -> Optional[int]:
+        """If set, handle_tick is invoked at roughly this period
+        (reference operator.rs:1167 handle_tick)."""
+        return None
+
+    def handle_tick(self, ctx: OperatorContext, collector: "Collector") -> None:
+        pass
+
+    def on_close(self, ctx: OperatorContext, collector: "Collector") -> None:
+        """All inputs reached end-of-data; emit any remaining state."""
+
+
+class SourceOperator:
+    """Source (reference SourceOperator, operator.rs:294-342).
+
+    ``run`` drives the source; it must call ``ctx_poll`` helpers frequently:
+    the run loop passes a SourceContext whose ``poll_control`` surfaces
+    checkpoint/stop commands from the engine.
+    """
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def tables(self) -> list[TableSpec]:
+        return []
+
+    def on_start(self, ctx: OperatorContext) -> None:
+        pass
+
+    def run(self, ctx: OperatorContext, collector: "Collector") -> SourceFinishType:
+        raise NotImplementedError
+
+    def on_close(self, ctx: OperatorContext, collector: "Collector") -> None:
+        pass

@@ -1,0 +1,92 @@
+"""Collector: output routing + keyed repartition.
+
+Equivalent of the reference's ArrowCollector
+(crates/arroyo-operator/src/context.rs:502-603): hash routing keys ->
+server_for_hash -> sort -> slice per destination; round-robin slices with a
+rotating offset when unkeyed; signals broadcast to every output partition.
+
+On a TPU mesh this repartition disappears into device collectives
+(arroyo_tpu.parallel lowers keyed exchange to all_to_all over ICI); this host
+collector remains the cross-process / cross-operator path.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from ..batch import KEY_FIELD, Batch
+from ..engine.queues import TaskInbox
+from ..graph import EdgeType
+from ..hashing import servers_for_hashes
+from ..types import Signal
+
+
+@dataclass
+class OutEdge:
+    """One logical out-edge: destinations are the downstream subtask inboxes,
+    with this producer's flat input index at each destination."""
+
+    edge_type: EdgeType
+    dests: Sequence[TaskInbox]
+    dest_input_index: Sequence[int]  # parallel to dests: our input idx there
+
+
+class Collector:
+    def __init__(self, out_edges: list[OutEdge], subtask_index: int):
+        self.out_edges = out_edges
+        self.subtask_index = subtask_index
+        self._rr_offset = random.randrange(1 << 16)
+        self.batches_sent = 0
+        self.rows_sent = 0
+
+    def collect(self, batch: Batch) -> None:
+        if batch.num_rows == 0:
+            return
+        self.batches_sent += 1
+        self.rows_sent += batch.num_rows
+        for edge in self.out_edges:
+            n = len(edge.dests)
+            if n == 1:
+                edge.dests[0].put(edge.dest_input_index[0], batch)
+            elif edge.edge_type == EdgeType.FORWARD:
+                d = self.subtask_index % n
+                edge.dests[d].put(edge.dest_input_index[d], batch)
+            elif KEY_FIELD in batch:
+                self._shuffle_keyed(batch, edge)
+            else:
+                self._shuffle_round_robin(batch, edge)
+
+    def _shuffle_keyed(self, batch: Batch, edge: OutEdge) -> None:
+        n = len(edge.dests)
+        dests = servers_for_hashes(batch.keys, n)
+        order = np.argsort(dests, kind="stable")
+        sorted_dests = dests[order]
+        bounds = np.searchsorted(sorted_dests, np.arange(n + 1))
+        sorted_batch = batch.take(order)
+        for d in range(n):
+            lo, hi = bounds[d], bounds[d + 1]
+            if hi > lo:
+                edge.dests[d].put(edge.dest_input_index[d], sorted_batch.slice(lo, hi))
+
+    def _shuffle_round_robin(self, batch: Batch, edge: OutEdge) -> None:
+        # Rotating even slices (reference context.rs:539-554).
+        n = len(edge.dests)
+        rows = batch.num_rows
+        per = (rows + n - 1) // n
+        start_dest = self._rr_offset % n
+        self._rr_offset += 1
+        for i in range(n):
+            lo, hi = i * per, min((i + 1) * per, rows)
+            if hi > lo:
+                d = (start_dest + i) % n
+                edge.dests[d].put(edge.dest_input_index[d], batch.slice(lo, hi))
+
+    def broadcast(self, signal: Signal) -> None:
+        """Signals go to every output partition (reference context.rs:655-669)."""
+        for edge in self.out_edges:
+            for dest, idx in zip(edge.dests, edge.dest_input_index):
+                dest.put(idx, signal)

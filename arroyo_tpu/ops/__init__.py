@@ -1,0 +1,17 @@
+"""Device (JAX/XLA/Pallas) compute runtime.
+
+64-bit support is required: routing keys are 64-bit hashes and integer SUM
+accumulators need i64 range. TPUs emulate i64 with i32 limb pairs under XLA;
+enabling x64 here (before any jax arrays exist) keeps key comparisons exact.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .aggregate import (  # noqa: F401,E402
+    AGG_KINDS,
+    DeviceHashAggregator,
+    acc_kinds_for,
+    finalize_aggs,
+)

@@ -1,0 +1,404 @@
+"""Keyed windowed aggregation on device: HBM-resident hash-table state.
+
+This replaces the reference's DataFusion partial/finish aggregate plans
+(crates/arroyo-worker/src/arrow/tumbling_aggregating_window.rs:49,
+sliding_aggregating_window.rs:45) with a TPU-native design:
+
+  state (HBM, persistent across micro-batches, donated through jit):
+      keys      int64[cap]   -- 64-bit key hash (uint64 bits viewed as int64)
+      bins      int32[cap]   -- window bin index (timestamp // bin_width)
+      occupied  bool[cap]
+      accs      tuple of [cap] arrays, one per accumulator
+
+  step (jit, one fused XLA program per operator config):
+      1. lexsort incoming (bin, key) pairs -> adjacent duplicates
+      2. segment-reduce each accumulator -> <=B unique (bin, key) partials
+      3. merge partials into the table with linear probing: matches combine
+         via scatter; empty-slot claims race-resolved with a scatter-max of
+         the contender index (classic GPU hash-build, expressed as XLA
+         scatter/gather under lax.fori_loop so it compiles to one program)
+
+  extract (jit): compact closed bins out of the table with an argsort on the
+      close mask; destructive (tumbling close) or range-scan (sliding).
+
+Static shapes everywhere: batches padded to ``batch_cap``, table capacity and
+probe count fixed at trace time; no data-dependent control flow inside jit.
+A NumPy mirror backend provides the CPU oracle for differential tests and the
+bench baseline.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+AGG_KINDS = ("sum", "count", "min", "max")
+
+_I64_MAX = np.iinfo(np.int64).max
+_I32_MAX = np.iinfo(np.int32).max
+
+
+def acc_kinds_for(kind: str) -> tuple[str, ...]:
+    """Accumulators backing one SQL aggregate (avg -> sum+count)."""
+    if kind == "avg":
+        return ("sum", "count")
+    if kind in AGG_KINDS:
+        return (kind,)
+    raise ValueError(f"unsupported aggregate {kind}")
+
+
+def finalize_aggs(kinds: Sequence[str], acc_arrays: list[np.ndarray]) -> list[np.ndarray]:
+    """acc arrays (in acc_kinds_for order, flattened) -> one array per SQL agg."""
+    out = []
+    i = 0
+    for kind in kinds:
+        if kind == "avg":
+            s, c = acc_arrays[i], acc_arrays[i + 1]
+            i += 2
+            out.append(np.divide(s, np.maximum(c, 1)).astype(np.float64))
+        else:
+            out.append(acc_arrays[i])
+            i += 1
+    return out
+
+
+def _identity(kind: str, dtype):
+    if kind in ("sum", "count"):
+        return np.array(0, dtype=dtype)
+    if kind == "min":
+        return np.array(np.iinfo(dtype).max if np.issubdtype(dtype, np.integer) else np.inf, dtype=dtype)
+    if kind == "max":
+        return np.array(np.iinfo(dtype).min if np.issubdtype(dtype, np.integer) else -np.inf, dtype=dtype)
+    raise ValueError(kind)
+
+
+# =========================================================================
+# jax backend
+# =========================================================================
+
+
+@functools.lru_cache(maxsize=None)
+def _build_jax(acc_kinds: tuple[str, ...], acc_dtypes: tuple, cap: int, batch_cap: int,
+               max_probes: int, emit_cap: int):
+    import jax
+    import jax.numpy as jnp
+
+    n_acc = len(acc_kinds)
+    mask_cap = cap - 1
+    assert cap & mask_cap == 0, "table capacity must be a power of two"
+
+    def seg_reduce(kind, vals, seg, valid):
+        if kind in ("sum", "count"):
+            v = jnp.where(valid, vals, 0)
+            return jax.ops.segment_sum(v, seg, num_segments=batch_cap)
+        if kind == "min":
+            v = jnp.where(valid, vals, _identity("min", np.dtype(vals.dtype)))
+            return jax.ops.segment_min(v, seg, num_segments=batch_cap)
+        v = jnp.where(valid, vals, _identity("max", np.dtype(vals.dtype)))
+        return jax.ops.segment_max(v, seg, num_segments=batch_cap)
+
+    def combine(kind, a, b):
+        if kind in ("sum", "count"):
+            return a + b
+        if kind == "min":
+            return jnp.minimum(a, b)
+        return jnp.maximum(a, b)
+
+    def slot_hash(key, bins):
+        z = key.astype(jnp.uint64) ^ (bins.astype(jnp.uint64) * jnp.uint64(0xFF51AFD7ED558CCD))
+        z = (z ^ (z >> jnp.uint64(33))) * jnp.uint64(0xC4CEB9FE1A85EC53)
+        z = z ^ (z >> jnp.uint64(33))
+        return (z & jnp.uint64(mask_cap)).astype(jnp.int32)
+
+    def step(state, key, bins, valid, vals):
+        keys_t, bins_t, occ_t, accs_t, oflow_t = state
+        # ---- 1. sort so duplicate (bin, key) pairs are adjacent
+        skey = jnp.where(valid, key, _I64_MAX)
+        sbin = jnp.where(valid, bins, _I32_MAX)
+        order = jnp.lexsort((sbin, skey))
+        k_s = skey[order]
+        b_s = sbin[order]
+        valid_s = valid[order]
+        newseg = jnp.concatenate(
+            [jnp.ones(1, dtype=bool),
+             (k_s[1:] != k_s[:-1]) | (b_s[1:] != b_s[:-1])]
+        )
+        seg = jnp.cumsum(newseg) - 1
+        # ---- 2. segment-reduce each accumulator
+        u_accs = tuple(
+            seg_reduce(acc_kinds[i], vals[i][order], seg, valid_s) for i in range(n_acc)
+        )
+        rows_per_seg = jax.ops.segment_sum(valid_s.astype(jnp.int32), seg, num_segments=batch_cap)
+        # representative key/bin per segment (all rows in a segment are equal)
+        u_key = jax.ops.segment_max(k_s, seg, num_segments=batch_cap)
+        u_bin = jax.ops.segment_max(b_s, seg, num_segments=batch_cap)
+        active0 = rows_per_seg > 0
+        # ---- 3. probing merge into the table
+        h0 = slot_hash(u_key, u_bin)
+        seg_pos = jnp.arange(batch_cap, dtype=jnp.int32)
+
+        def probe(i, carry):
+            keys_c, bins_c, occ_c, accs_c, active = carry
+            cand = (h0 + i) & mask_cap
+            cur_key = keys_c[cand]
+            cur_bin = bins_c[cand]
+            cur_occ = occ_c[cand]
+            match = active & cur_occ & (cur_key == u_key) & (cur_bin == u_bin)
+            empty_here = active & ~cur_occ
+            claim_idx = jnp.where(empty_here, cand, cap)
+            claims = jnp.full(cap, -1, dtype=jnp.int32).at[claim_idx].max(seg_pos, mode="drop")
+            won = empty_here & (claims[cand] == seg_pos)
+            write = match | won
+            safe = jnp.where(write, cand, cap)
+            keys_c = keys_c.at[safe].set(u_key, mode="drop")
+            bins_c = bins_c.at[safe].set(u_bin, mode="drop")
+            occ_c = occ_c.at[safe].set(True, mode="drop")
+            new_accs = []
+            for j in range(n_acc):
+                merged = combine(acc_kinds[j], accs_c[j][cand], u_accs[j])
+                val = jnp.where(match, merged, u_accs[j])
+                new_accs.append(accs_c[j].at[safe].set(val, mode="drop"))
+            return (keys_c, bins_c, occ_c, tuple(new_accs), active & ~write)
+
+        keys_t, bins_t, occ_t, accs_t, still_active = jax.lax.fori_loop(
+            0, max_probes, probe, (keys_t, bins_t, occ_t, accs_t, active0)
+        )
+        # overflow accumulates in device state; the host checks it at the
+        # next extract/snapshot boundary instead of syncing every batch
+        oflow_t = oflow_t + jnp.sum(still_active, dtype=jnp.int32)
+        return (keys_t, bins_t, occ_t, accs_t, oflow_t)
+
+    def extract(state, emit_lo, emit_hi, free_below):
+        """Emit occupied entries with emit_lo <= bin < emit_hi (compacted to
+        emit_cap rows); free entries with bin < free_below."""
+        keys_t, bins_t, occ_t, accs_t, oflow_t = state
+        emit_mask = occ_t & (bins_t >= emit_lo) & (bins_t < emit_hi)
+        total = jnp.sum(emit_mask)
+        order = jnp.argsort(~emit_mask)  # True (0 after ~) first, stable
+        sel = order[:emit_cap]
+        out_valid = emit_mask[sel]
+        out_key = keys_t[sel]
+        out_bin = bins_t[sel]
+        out_accs = tuple(a[sel] for a in accs_t)
+        # free expired entries OUTSIDE the emit range immediately; entries in
+        # the emit range are freed only once actually emitted, so a drain
+        # loop over emit_cap-sized chunks doesn't drop the tail
+        free_mask = occ_t & (bins_t < free_below) & ~emit_mask
+        emitted_free = out_valid & (out_bin < free_below)
+        occ_t = occ_t & ~free_mask
+        occ_t = occ_t.at[jnp.where(emitted_free, sel, cap)].set(False, mode="drop")
+        return (keys_t, bins_t, occ_t, accs_t, oflow_t), (out_key, out_bin, out_valid, out_accs, total)
+
+    step_j = jax.jit(step, donate_argnums=0)
+    extract_j = jax.jit(extract, donate_argnums=0)
+    return step_j, extract_j
+
+
+# =========================================================================
+# host-facing wrapper
+# =========================================================================
+
+
+class DeviceHashAggregator:
+    """Streaming (bin, key) -> accumulators store.
+
+    backend="jax": state lives in HBM, update/extract are single XLA programs.
+    backend="numpy": dict-based host mirror (differential-test oracle and the
+    CPU baseline for bench vs_baseline).
+    """
+
+    def __init__(
+        self,
+        acc_kinds: Sequence[str],
+        acc_dtypes: Sequence[np.dtype],
+        cap: int = 65536,
+        batch_cap: int = 8192,
+        max_probes: int = 64,
+        emit_cap: int = 8192,
+        backend: str = "jax",
+    ):
+        self.acc_kinds = tuple(acc_kinds)
+        self.acc_dtypes = tuple(np.dtype(d) for d in acc_dtypes)
+        self.cap = cap
+        self.batch_cap = batch_cap
+        self.max_probes = max_probes
+        self.emit_cap = emit_cap
+        self.backend = backend
+        if backend == "jax":
+            self._step, self._extract = _build_jax(
+                self.acc_kinds, self.acc_dtypes, cap, batch_cap, max_probes, emit_cap
+            )
+            self.state = self._init_jax_state()
+        else:
+            self.store: dict[tuple[int, int], list] = {}
+
+    def _init_jax_state(self):
+        import jax.numpy as jnp
+
+        keys = jnp.zeros(self.cap, dtype=jnp.int64)
+        bins = jnp.zeros(self.cap, dtype=jnp.int32)
+        occ = jnp.zeros(self.cap, dtype=bool)
+        accs = tuple(
+            jnp.full(self.cap, _identity(k, d), dtype=d)
+            for k, d in zip(self.acc_kinds, self.acc_dtypes)
+        )
+        return (keys, bins, occ, accs, jnp.zeros((), dtype=jnp.int32))
+
+    # ------------------------------------------------------------- update
+
+    def update(self, key_u64: np.ndarray, bins: np.ndarray, vals: Sequence[np.ndarray]) -> None:
+        n = len(key_u64)
+        if n == 0:
+            return
+        if self.backend == "numpy":
+            self._update_numpy(key_u64, bins, vals)
+            return
+        for lo in range(0, n, self.batch_cap):
+            hi = min(lo + self.batch_cap, n)
+            self._update_chunk(key_u64[lo:hi], bins[lo:hi], [v[lo:hi] for v in vals])
+
+    def _update_chunk(self, key_u64, bins, vals) -> None:
+        m = len(key_u64)
+        B = self.batch_cap
+        key = np.zeros(B, dtype=np.int64)
+        key[:m] = key_u64.astype(np.uint64).view(np.int64)
+        b = np.zeros(B, dtype=np.int32)
+        b[:m] = bins
+        valid = np.zeros(B, dtype=bool)
+        valid[:m] = True
+        vs = []
+        for v, dt in zip(vals, self.acc_dtypes):
+            arr = np.zeros(B, dtype=dt)
+            arr[:m] = v
+            vs.append(arr)
+        self.state = self._step(self.state, key, b, valid, tuple(vs))
+
+    def _check_overflow(self) -> None:
+        overflow = int(self.state[4])
+        if overflow > 0:
+            raise RuntimeError(
+                f"device aggregate table overflow ({overflow} entries dropped after "
+                f"{self.max_probes} probes; cap={self.cap}) — raise device.table-capacity"
+            )
+
+    def _update_numpy(self, key_u64, bins, vals) -> None:
+        signed = key_u64.astype(np.uint64).view(np.int64)
+        order = np.lexsort((signed, bins))
+        k_s, b_s = signed[order], np.asarray(bins)[order]
+        vs = [np.asarray(v)[order] for v in vals]
+        newseg = np.ones(len(k_s), dtype=bool)
+        newseg[1:] = (k_s[1:] != k_s[:-1]) | (b_s[1:] != b_s[:-1])
+        starts = np.flatnonzero(newseg)
+        ends = np.append(starts[1:], len(k_s))
+        for s, e in zip(starts, ends):
+            kk = (int(b_s[s]), int(k_s[s]))
+            cur = self.store.get(kk)
+            parts = []
+            for i, kind in enumerate(self.acc_kinds):
+                seg = vs[i][s:e]
+                red = seg.sum() if kind in ("sum", "count") else (seg.min() if kind == "min" else seg.max())
+                if cur is not None:
+                    red = (
+                        cur[i] + red
+                        if kind in ("sum", "count")
+                        else (min(cur[i], red) if kind == "min" else max(cur[i], red))
+                    )
+                parts.append(self.acc_dtypes[i].type(red))
+            self.store[kk] = parts
+
+    # ------------------------------------------------------------- extract
+
+    def extract(
+        self, emit_lo: int, emit_hi: int, free_below: int
+    ) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
+        """Returns (key_u64, bin, acc_arrays) for bins in [emit_lo, emit_hi);
+        frees all entries with bin < free_below. Host loops until drained."""
+        if self.backend == "numpy":
+            return self._extract_numpy(emit_lo, emit_hi, free_below)
+        self._check_overflow()
+        keys_out, bins_out, accs_out = [], [], [[] for _ in self.acc_kinds]
+        while True:
+            self.state, (k, b, valid, accs, total) = self._extract(
+                self.state, np.int32(emit_lo), np.int32(emit_hi), np.int32(free_below)
+            )
+            valid = np.asarray(valid)
+            cnt = valid.sum()
+            if cnt:
+                keys_out.append(np.asarray(k)[valid])
+                bins_out.append(np.asarray(b)[valid])
+                for i, a in enumerate(accs):
+                    accs_out[i].append(np.asarray(a)[valid])
+            total = int(total)
+            if total <= self.emit_cap or cnt == 0:
+                break
+            # more closed entries than emit_cap: emitted ones were freed only
+            # if below free_below; for range scans everything fit emit_cap
+            if free_below <= emit_lo:
+                break
+        if not keys_out:
+            empty = [np.empty(0, dtype=d) for d in self.acc_dtypes]
+            return (
+                np.empty(0, dtype=np.uint64),
+                np.empty(0, dtype=np.int32),
+                empty,
+            )
+        return (
+            np.concatenate(keys_out).view(np.uint64),
+            np.concatenate(bins_out),
+            [np.concatenate(a) for a in accs_out],
+        )
+
+    def _extract_numpy(self, emit_lo, emit_hi, free_below):
+        ks, bs, accs = [], [], [[] for _ in self.acc_kinds]
+        for (b, k), parts in self.store.items():
+            if emit_lo <= b < emit_hi:
+                ks.append(k)
+                bs.append(b)
+                for i, p in enumerate(parts):
+                    accs[i].append(p)
+        for kk in [kk for kk in self.store if kk[0] < free_below]:
+            del self.store[kk]
+        return (
+            np.array(ks, dtype=np.int64).view(np.uint64) if ks else np.empty(0, dtype=np.uint64),
+            np.array(bs, dtype=np.int32),
+            [np.array(a, dtype=d) for a, d in zip(accs, self.acc_dtypes)],
+        )
+
+    # ------------------------------------------------------------- state sync
+
+    def snapshot(self) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
+        """Full host copy of live entries (checkpoint path)."""
+        if self.backend == "numpy":
+            if not self.store:
+                return (np.empty(0, dtype=np.uint64), np.empty(0, dtype=np.int32),
+                        [np.empty(0, dtype=d) for d in self.acc_dtypes])
+            items = list(self.store.items())
+            ks = np.array([k for (_, k), _ in items], dtype=np.int64).view(np.uint64)
+            bs = np.array([b for (b, _), _ in items], dtype=np.int32)
+            accs = [np.array([p[i] for _, p in items], dtype=d)
+                    for i, d in enumerate(self.acc_dtypes)]
+            return ks, bs, accs
+        self._check_overflow()
+        keys_t, bins_t, occ_t, accs_t, _oflow = self.state
+        occ = np.asarray(occ_t)
+        return (
+            np.asarray(keys_t)[occ].view(np.uint64),
+            np.asarray(bins_t)[occ],
+            [np.asarray(a)[occ] for a in accs_t],
+        )
+
+    def restore(self, key_u64: np.ndarray, bins: np.ndarray, accs: list[np.ndarray]) -> None:
+        if self.backend == "numpy":
+            signed = key_u64.astype(np.uint64).view(np.int64)
+            self.store = {
+                (int(bins[j]), int(signed[j])): [
+                    self.acc_dtypes[i].type(accs[i][j]) for i in range(len(self.acc_kinds))
+                ]
+                for j in range(len(signed))
+            }
+            return
+        self.state = self._init_jax_state()
+        self.update(key_u64, bins.astype(np.int32), accs)

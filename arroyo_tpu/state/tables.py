@@ -1,0 +1,290 @@
+"""Keyed / timed state tables with Parquet checkpoints.
+
+Equivalent of crates/arroyo-state: TableManager (tables/table_manager.rs:35),
+ExpiringTimeKeyTable (tables/expiring_time_key_map.rs:47), GlobalKeyedTable
+(tables/global_keyed_map.rs:42), checkpoint path scheme (tables/mod.rs:20-43):
+
+    {job}/checkpoints/checkpoint-{epoch:07}/operator-{op}/table-{name}-{subtask:03}
+
+Restore filters Parquet files by (a) watermark-retention overlap and (b) the
+restoring subtask's routing-key-range overlap, which is what makes restore at
+a different parallelism (rescaling) work — same semantics as the reference
+(expiring_time_key_map.rs restore path; tables/mod.rs:106-110).
+
+In the TPU design the authoritative window state lives in HBM between
+watermarks; operators mirror it into these host tables at barrier time only
+(handle_checkpoint), so snapshots are taken at consistent step boundaries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch, Schema
+from ..types import TaskInfo
+
+
+def checkpoint_dir(storage_url: str, job_id: str, epoch: int) -> str:
+    return os.path.join(storage_url, job_id, "checkpoints", f"checkpoint-{epoch:07d}")
+
+
+def operator_dir(storage_url: str, job_id: str, epoch: int, node_id: str) -> str:
+    return os.path.join(checkpoint_dir(storage_url, job_id, epoch), f"operator-{node_id}")
+
+
+class GlobalKeyedTable:
+    """Small K/V state, full copy per checkpoint (global_keyed_map.rs:42).
+    Used for source offsets, watermark-generator state, session metadata."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.data: dict[Any, Any] = {}
+
+    def get(self, key, default=None):
+        return self.data.get(key, default)
+
+    def insert(self, key, value) -> None:
+        self.data[key] = value
+
+    def delete(self, key) -> None:
+        self.data.pop(key, None)
+
+    def items(self):
+        return self.data.items()
+
+    # -- checkpoint ---------------------------------------------------------
+
+    def write_checkpoint(self, path: str) -> dict:
+        with open(path, "wb") as f:
+            pickle.dump(self.data, f)
+        return {"file": os.path.basename(path), "kind": "global_keyed"}
+
+    def load_files(self, paths: Iterable[str]) -> None:
+        for p in paths:
+            with open(p, "rb") as f:
+                self.data.update(pickle.load(f))
+
+
+class ExpiringTimeKeyTable:
+    """Batches bucketed by event time with retention
+    (expiring_time_key_map.rs:47). Holds columnar batches; rows carry
+    _timestamp and (if keyed) _key columns used for expiry and rescale."""
+
+    def __init__(self, name: str, retention_micros: int = 0):
+        self.name = name
+        self.retention_micros = retention_micros
+        self.batches: list[Batch] = []
+
+    def insert(self, batch: Batch) -> None:
+        if batch.num_rows:
+            self.batches.append(batch)
+
+    def replace_all(self, batches: list[Batch]) -> None:
+        self.batches = [b for b in batches if b.num_rows]
+
+    def all_batches(self) -> list[Batch]:
+        return list(self.batches)
+
+    def expire(self, watermark_micros: int) -> None:
+        """Drop rows older than watermark - retention
+        (expiring_time_key_map.rs:816-849)."""
+        cutoff = watermark_micros - self.retention_micros
+        kept = []
+        for b in self.batches:
+            mask = b.timestamps >= cutoff
+            if mask.all():
+                kept.append(b)
+            elif mask.any():
+                kept.append(b.filter(mask))
+        self.batches = kept
+
+    def total_rows(self) -> int:
+        return sum(b.num_rows for b in self.batches)
+
+    # -- checkpoint ---------------------------------------------------------
+
+    def write_checkpoint(self, path: str) -> Optional[dict]:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        if not self.batches:
+            return None
+        merged = Batch.concat(self.batches)
+        arrays, names = [], []
+        for name, col in merged.columns.items():
+            names.append(name)
+            if col.dtype == object:
+                arrays.append(pa.array([None if v is None else str(v) for v in col], type=pa.string()))
+            else:
+                arrays.append(pa.array(col))
+        pq.write_table(pa.table(arrays, names=names), path)
+        ts = merged.timestamps
+        meta = {
+            "file": os.path.basename(path),
+            "kind": "expiring_time_key",
+            "min_timestamp": int(ts.min()),
+            "max_timestamp": int(ts.max()),
+        }
+        if KEY_FIELD in merged:
+            k = merged.keys
+            meta["min_key"] = int(k.min())
+            meta["max_key"] = int(k.max())
+        return meta
+
+    def load_files(
+        self,
+        entries: Iterable[tuple[str, dict]],
+        key_range: tuple[int, int],
+        watermark_micros: Optional[int],
+    ) -> None:
+        """Restore: read files overlapping our key range & retention window."""
+        import pyarrow.parquet as pq
+
+        cutoff = None
+        if watermark_micros is not None and self.retention_micros:
+            cutoff = watermark_micros - self.retention_micros
+        lo, hi = key_range
+        for path, meta in entries:
+            if cutoff is not None and meta.get("max_timestamp", 1 << 62) < cutoff:
+                continue
+            if "min_key" in meta and (meta["min_key"] > hi or meta["max_key"] < lo):
+                continue
+            table = pq.read_table(path)
+            cols: dict[str, np.ndarray] = {}
+            for name in table.column_names:
+                arr = table.column(name)
+                if arr.type == "string" or str(arr.type) in ("string", "large_string"):
+                    cols[name] = np.array(arr.to_pylist(), dtype=object)
+                else:
+                    cols[name] = np.asarray(arr.to_numpy(zero_copy_only=False))
+            batch = Batch(cols)
+            if KEY_FIELD in batch:
+                keys = batch.keys
+                mask = (keys >= np.uint64(lo)) & (keys <= np.uint64(hi))
+                if not mask.all():
+                    batch = batch.filter(mask)
+            if cutoff is not None and batch.num_rows:
+                mask = batch.timestamps >= cutoff
+                if not mask.all():
+                    batch = batch.filter(mask)
+            if batch.num_rows:
+                self.batches.append(batch)
+
+
+class TableManager:
+    """Per-subtask state facade (tables/table_manager.rs:35)."""
+
+    def __init__(self, task_info: TaskInfo, storage_url: str):
+        self.task_info = task_info
+        self.storage_url = storage_url
+        self.globals: dict[str, GlobalKeyedTable] = {}
+        self.expiring: dict[str, ExpiringTimeKeyTable] = {}
+
+    def global_keyed(self, name: str) -> GlobalKeyedTable:
+        if name not in self.globals:
+            self.globals[name] = GlobalKeyedTable(name)
+        return self.globals[name]
+
+    def expiring_time_key(self, name: str, retention_micros: int = 0) -> ExpiringTimeKeyTable:
+        if name not in self.expiring:
+            self.expiring[name] = ExpiringTimeKeyTable(name, retention_micros)
+        t = self.expiring[name]
+        if retention_micros:
+            t.retention_micros = retention_micros
+        return t
+
+    # -- checkpoint / restore ----------------------------------------------
+
+    def checkpoint(self, epoch: int, watermark_micros: Optional[int]) -> dict:
+        """Write all tables; returns subtask metadata for the engine to merge
+        (reference: flusher write + OperatorCheckpointMetadata merge)."""
+        ti = self.task_info
+        opdir = operator_dir(self.storage_url, ti.job_id, epoch, ti.node_id)
+        os.makedirs(opdir, exist_ok=True)
+        sub = f"{ti.subtask_index:03d}"
+        files = []
+        for name, table in self.globals.items():
+            meta = table.write_checkpoint(os.path.join(opdir, f"table-{name}-{sub}.bin"))
+            meta["table"] = name
+            files.append(meta)
+        for name, table in self.expiring.items():
+            meta = table.write_checkpoint(os.path.join(opdir, f"table-{name}-{sub}.parquet"))
+            if meta is not None:
+                meta["table"] = name
+                meta["retention_micros"] = table.retention_micros
+                files.append(meta)
+        meta = {
+            "node_id": ti.node_id,
+            "subtask_index": ti.subtask_index,
+            "watermark_micros": watermark_micros,
+            "files": files,
+        }
+        with open(os.path.join(opdir, f"metadata-{sub}.json"), "w") as f:
+            json.dump(meta, f)
+        return meta
+
+    def restore(self, epoch: int, table_specs: list) -> Optional[int]:
+        """Load state written at ``epoch`` (possibly at different parallelism).
+        Returns the restored watermark (min across prior subtasks), if any."""
+        ti = self.task_info
+        opdir = operator_dir(self.storage_url, ti.job_id, epoch, ti.node_id)
+        if not os.path.isdir(opdir):
+            return None
+        metas = []
+        for fn in sorted(os.listdir(opdir)):
+            if fn.startswith("metadata-") and fn.endswith(".json"):
+                with open(os.path.join(opdir, fn)) as f:
+                    metas.append(json.load(f))
+        watermarks = [m["watermark_micros"] for m in metas if m.get("watermark_micros") is not None]
+        restored_wm = min(watermarks) if watermarks else None
+        spec_by_name = {s.name: s for s in table_specs}
+        by_table: dict[str, list[tuple[str, dict]]] = {}
+        for m in metas:
+            for fmeta in m["files"]:
+                by_table.setdefault(fmeta["table"], []).append(
+                    (os.path.join(opdir, fmeta["file"]), fmeta)
+                )
+        for tname, entries in by_table.items():
+            spec = spec_by_name.get(tname)
+            kind = entries[0][1].get("kind")
+            if kind == "global_keyed":
+                self.global_keyed(tname).load_files(p for p, _ in entries)
+            else:
+                retention = spec.retention_micros if spec else entries[0][1].get("retention_micros", 0)
+                self.expiring_time_key(tname, retention).load_files(
+                    entries, ti.key_range, restored_wm
+                )
+        return restored_wm
+
+
+def write_job_checkpoint_metadata(
+    storage_url: str, job_id: str, epoch: int, extra: Optional[dict] = None
+) -> str:
+    """Job-level commit marker once every subtask finished its snapshot
+    (reference: controller CheckpointState -> CheckpointMetadata)."""
+    d = checkpoint_dir(storage_url, job_id, epoch)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, "metadata.json")
+    payload = {"job_id": job_id, "epoch": epoch}
+    if extra:
+        payload.update(extra)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def latest_complete_checkpoint(storage_url: str, job_id: str) -> Optional[int]:
+    base = os.path.join(storage_url, job_id, "checkpoints")
+    if not os.path.isdir(base):
+        return None
+    epochs = []
+    for fn in os.listdir(base):
+        if fn.startswith("checkpoint-") and os.path.exists(os.path.join(base, fn, "metadata.json")):
+            epochs.append(int(fn.split("-")[1]))
+    return max(epochs) if epochs else None

@@ -1,0 +1,185 @@
+"""Core dataflow types.
+
+TPU-native re-design of the reference's core types
+(reference: crates/arroyo-types/src/lib.rs — Watermark :162, ArrowMessage :168,
+SignalMessage :174, CheckpointBarrier :481, TaskInfo :375, Window :14,
+server_for_hash/range_for_server :621/:630, JoinType :354).
+
+Timestamps are int64 microseconds since the unix epoch throughout (the reference
+uses SystemTime with microsecond precision in its Arrow schemas).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+U64_MAX = (1 << 64) - 1
+
+# Sentinel timestamp used for "idle" watermarks.
+IDLE = None
+
+
+@dataclass(frozen=True)
+class Watermark:
+    """Event-time watermark. ``value is None`` means the source is idle
+    (reference: arroyo-types/src/lib.rs:162 Watermark::{EventTime, Idle})."""
+
+    value: Optional[int]  # micros, or None for Idle
+
+    @property
+    def is_idle(self) -> bool:
+        return self.value is None
+
+    @staticmethod
+    def event_time(micros: int) -> "Watermark":
+        return Watermark(int(micros))
+
+    @staticmethod
+    def idle() -> "Watermark":
+        return Watermark(None)
+
+
+@dataclass(frozen=True)
+class CheckpointBarrier:
+    """Aligned checkpoint barrier flowing with the data
+    (reference: arroyo-types/src/lib.rs:481)."""
+
+    epoch: int
+    min_epoch: int = 0
+    timestamp: int = 0  # micros
+    then_stop: bool = False
+
+
+class SignalKind(enum.Enum):
+    BARRIER = "barrier"
+    WATERMARK = "watermark"
+    STOP = "stop"
+    END_OF_DATA = "end_of_data"
+
+
+@dataclass(frozen=True)
+class Signal:
+    """In-band control message interleaved with data batches
+    (reference: arroyo-types/src/lib.rs:174 SignalMessage)."""
+
+    kind: SignalKind
+    watermark: Optional[Watermark] = None
+    barrier: Optional[CheckpointBarrier] = None
+
+    @staticmethod
+    def watermark_of(wm: Watermark) -> "Signal":
+        return Signal(SignalKind.WATERMARK, watermark=wm)
+
+    @staticmethod
+    def barrier_of(b: CheckpointBarrier) -> "Signal":
+        return Signal(SignalKind.BARRIER, barrier=b)
+
+    @staticmethod
+    def stop() -> "Signal":
+        return Signal(SignalKind.STOP)
+
+    @staticmethod
+    def end_of_data() -> "Signal":
+        return Signal(SignalKind.END_OF_DATA)
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    FULL = "full"
+
+
+@dataclass(frozen=True)
+class Window:
+    """Half-open event-time interval [start, end) in micros
+    (reference: arroyo-types/src/lib.rs:14)."""
+
+    start: int
+    end: int
+
+    def contains(self, ts: int) -> bool:
+        return self.start <= ts < self.end
+
+
+class SourceFinishType(enum.Enum):
+    """How a source run() ended (reference: arroyo-operator/src/operator.rs)."""
+
+    GRACEFUL = "graceful"  # emit EndOfData downstream, drain windows
+    IMMEDIATE = "immediate"  # stop now (Stop signal)
+    FINAL = "final"  # checkpoint-then-stop completed
+
+
+@dataclass(frozen=True)
+class TaskInfo:
+    """Identity of one physical subtask
+    (reference: arroyo-types/src/lib.rs:375)."""
+
+    job_id: str
+    node_id: str
+    operator_name: str
+    subtask_index: int
+    parallelism: int
+
+    @property
+    def key_range(self) -> tuple[int, int]:
+        return range_for_server(self.subtask_index, self.parallelism)
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.node_id}-{self.subtask_index}"
+
+
+def range_for_server(i: int, n: int) -> tuple[int, int]:
+    """Contiguous u64 hash range owned by subtask ``i`` of ``n``
+    (reference: arroyo-types/src/lib.rs:630). Inclusive [start, end]."""
+    if not 0 <= i < n:
+        raise ValueError(f"subtask {i} out of range for parallelism {n}")
+    size = (U64_MAX // n) + 1
+    start = size * i
+    end = U64_MAX if i == n - 1 else start + size - 1
+    return (start, end)
+
+
+def server_for_hash(h: int, n: int) -> int:
+    """Which of ``n`` subtasks owns 64-bit hash ``h``
+    (reference: arroyo-types/src/lib.rs:621)."""
+    size = (U64_MAX // n) + 1
+    return min(h // size, n - 1)
+
+
+# ---------------------------------------------------------------------------
+# Control plane messages (engine <-> tasks), reference arroyo-rpc/src/lib.rs:84/:133
+
+
+@dataclass(frozen=True)
+class ControlMessage:
+    """Engine -> task control (reference: arroyo-rpc/src/lib.rs:84)."""
+
+    kind: str  # "checkpoint" | "stop" | "commit" | "load_compacted" | "no_op"
+    barrier: Optional[CheckpointBarrier] = None
+    epoch: Optional[int] = None
+
+
+@dataclass
+class CheckpointEvent:
+    checkpoint_epoch: int
+    node_id: str
+    subtask_index: int
+    time_micros: int
+    event_type: str  # "started_alignment" | "started_checkpointing" | "finished_sync"
+
+
+@dataclass
+class ControlResp:
+    """Task -> engine status (reference: arroyo-rpc/src/lib.rs:133)."""
+
+    kind: str  # task_started|task_finished|task_failed|checkpoint_event|checkpoint_completed|error
+    node_id: str = ""
+    subtask_index: int = 0
+    error: Optional[str] = None
+    checkpoint_event: Optional[CheckpointEvent] = None
+    subtask_metadata: Optional[dict] = None  # checkpoint_completed payload
+    epoch: int = 0

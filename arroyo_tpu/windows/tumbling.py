@@ -1,0 +1,279 @@
+"""Tumbling window aggregate operator.
+
+Reference behavior: crates/arroyo-worker/src/arrow/
+tumbling_aggregating_window.rs:49 — bin incoming rows by the window width,
+feed per-bin partial aggregates incrementally, and on watermark >= bin end
+run the finish plan + optional final projection, stamping the window start as
+the output timestamp; partials checkpoint into an ExpiringTimeKey table
+(:470-483) and are re-binned on restore (:234-248).
+
+TPU-native redesign: partials live in HBM inside a DeviceHashAggregator
+keyed by (bin, key-hash); each micro-batch is one fused XLA step (sort ->
+segment-reduce -> probing merge); window close is a device-side compaction
+(extract) triggered by the watermark. Group-by column VALUES (not hashes) are
+kept in a host-side dictionary (hash -> row of key values) refreshed per
+batch — only the fixed-width hash travels to the device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch
+from ..config import config
+from ..engine.engine import register_operator
+from ..expr import Expr, eval_expr
+from ..graph import OpName
+from ..operators.base import Operator, TableSpec
+from ..types import Watermark
+
+WINDOW_START = "window_start"
+WINDOW_END = "window_end"
+
+
+def acc_plan(aggregates: list[tuple[str, str, Optional[Expr]]], schema_dtype_of) -> tuple:
+    """Flatten SQL aggregates into accumulator (kind, dtype, input) triples.
+
+    aggregates: [(out_name, kind, input_expr|None)]; count has no input.
+    Returns (acc_kinds, acc_dtypes, input_specs) where input_specs[i] is the
+    Expr for that accumulator or None for a count-style all-ones input.
+    """
+    kinds, dtypes, inputs = [], [], []
+    for _name, kind, expr in aggregates:
+        if kind == "count":
+            kinds.append("count")
+            dtypes.append(np.dtype(np.int64))
+            inputs.append(None)
+        elif kind == "avg":
+            kinds.extend(["sum", "count"])
+            dtypes.extend([np.dtype(np.float64), np.dtype(np.int64)])
+            inputs.extend([expr, None])
+        else:
+            kinds.append(kind)
+            dtypes.append(schema_dtype_of(expr))
+            inputs.append(expr)
+    return tuple(kinds), tuple(dtypes), tuple(inputs)
+
+
+class KeyDictionary:
+    """hash -> key-column values, for reconstructing group-by columns at
+    emission (device state stores only the 64-bit hash). Entries are evicted
+    once every bin that saw the key has closed, bounding host memory."""
+
+    def __init__(self, key_fields: list[str]):
+        self.key_fields = key_fields
+        self.values: dict[int, tuple] = {}
+        self.last_bin: dict[int, int] = {}
+
+    def observe(self, hashes: np.ndarray, bins: np.ndarray, batch: Batch) -> None:
+        if not self.key_fields:
+            return
+        u, first = np.unique(hashes, return_index=True)
+        cols = [batch[f] for f in self.key_fields]
+        # max bin per unique hash: sort once, take per-group maxima
+        order = np.argsort(hashes, kind="stable")
+        sorted_bins = np.maximum.reduceat(
+            np.asarray(bins)[order], np.searchsorted(hashes[order], u)
+        )
+        for h, i, b in zip(u.tolist(), first.tolist(), sorted_bins.tolist()):
+            if h not in self.values:
+                self.values[h] = tuple(c[i] for c in cols)
+                self.last_bin[h] = int(b)
+            elif b > self.last_bin[h]:
+                self.last_bin[h] = int(b)
+
+    def evict_closed(self, rel_before: int) -> None:
+        dead = [h for h, b in self.last_bin.items() if b < rel_before]
+        for h in dead:
+            del self.values[h]
+            del self.last_bin[h]
+
+    def lookup_columns(self, hashes: np.ndarray) -> dict[str, np.ndarray]:
+        out: dict[str, np.ndarray] = {}
+        if not self.key_fields:
+            return out
+        rows = [self.values[int(h)] for h in hashes]
+        for j, f in enumerate(self.key_fields):
+            vals = [r[j] for r in rows]
+            sample = vals[0] if vals else None
+            if isinstance(sample, (str, type(None))):
+                out[f] = np.array(vals, dtype=object)
+            else:
+                out[f] = np.array(vals)
+        return out
+
+
+class TumblingAggregate(Operator):
+    """config: width_micros, key_fields: list[str], aggregates:
+    [(name, kind, Expr|None)], final_projection: [(name, Expr)]|None,
+    input_dtype_of: callable Expr -> np.dtype (planner-provided), backend
+    override "jax"|"numpy"|None."""
+
+    def __init__(self, cfg: dict):
+        self.width = int(cfg["width_micros"])
+        self.key_fields: list[str] = list(cfg.get("key_fields", ()))
+        self.aggregates = cfg["aggregates"]
+        self.final_projection = cfg.get("final_projection")
+        dtype_of = cfg.get("input_dtype_of") or (lambda e: np.dtype(np.float64))
+        self.acc_kinds, self.acc_dtypes, self.acc_inputs = acc_plan(self.aggregates, dtype_of)
+        self.backend = cfg.get("backend") or (
+            "jax" if config().get("device.enabled") else "numpy"
+        )
+        self._agg = None
+        self.key_dict = KeyDictionary(self.key_fields)
+        self.base_bin: Optional[int] = None  # micros bin offset for int32 device bins
+        self.open_bins: set[int] = set()  # relative bins resident on device
+        self.emitted_before_rel: Optional[int] = None  # late-data boundary
+        self.late_rows = 0  # dropped as later than an emitted window
+
+    # ------------------------------------------------------------------
+
+    def tables(self):
+        # retention = width: a bin's partials live until its window closes
+        return [TableSpec("t", "expiring_time_key", retention_micros=self.width)]
+
+    def _aggregator(self):
+        if self._agg is None:
+            from ..ops.aggregate import DeviceHashAggregator
+
+            dev = config().section("device")
+            self._agg = DeviceHashAggregator(
+                self.acc_kinds,
+                self.acc_dtypes,
+                cap=dev.get("table-capacity", 65536),
+                batch_cap=dev.get("batch-capacity", 8192),
+                max_probes=dev.get("max-probes", 64),
+                emit_cap=dev.get("emit-capacity", 8192),
+                backend=self.backend,
+            )
+        return self._agg
+
+    def on_start(self, ctx):
+        tbl = ctx.table_manager.expiring_time_key("t", self.width)
+        batches = tbl.all_batches()
+        if batches:
+            restored = Batch.concat(batches)
+            self._restore_from_batch(restored)
+            tbl.replace_all([])
+
+    def _restore_from_batch(self, b: Batch) -> None:
+        hashes = b.keys.astype(np.uint64)
+        starts = b.timestamps
+        bins_abs = starts // self.width
+        self.base_bin = int(bins_abs.min())
+        rel = (bins_abs - self.base_bin).astype(np.int32)
+        accs = [b[f"__acc_{i}"].astype(d) for i, d in enumerate(self.acc_dtypes)]
+        self._aggregator().restore(hashes, rel, accs)
+        self.open_bins = set(np.unique(rel).tolist())
+        if self.key_fields:
+            self.key_dict.observe(hashes, rel, b)
+
+    # ------------------------------------------------------------------
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        ts = batch.timestamps
+        bins_abs = ts // self.width
+        if self.base_bin is None:
+            self.base_bin = int(bins_abs.min())
+        rel = (bins_abs - self.base_bin).astype(np.int32)
+        if self.emitted_before_rel is not None:
+            # drop rows behind already-emitted windows (reference drops
+            # late data rather than re-opening closed windows)
+            late = rel < self.emitted_before_rel
+            if late.any():
+                self.late_rows += int(late.sum())
+                if late.all():
+                    return
+                batch = batch.filter(~late)
+                rel = rel[~late]
+        n = batch.num_rows
+        if KEY_FIELD in batch:
+            hashes = batch.keys.astype(np.uint64)
+        else:
+            hashes = np.zeros(n, dtype=np.uint64)
+        self.key_dict.observe(hashes, rel, batch)
+        vals = []
+        for inp, dt in zip(self.acc_inputs, self.acc_dtypes):
+            if inp is None:
+                vals.append(np.ones(n, dtype=dt))
+            else:
+                vals.append(np.asarray(eval_expr(inp, batch.columns, n)).astype(dt))
+        self._aggregator().update(hashes, rel, vals)
+        self.open_bins.update(np.unique(rel).tolist())
+
+    def handle_watermark(self, watermark, ctx, collector):
+        if watermark.is_idle:
+            return watermark
+        closed_before_abs = watermark.value // self.width
+        self._emit_closed(closed_before_abs, collector)
+        return watermark
+
+    def on_close(self, ctx, collector):
+        self._emit_closed(None, collector)
+
+    def _emit_closed(self, closed_before_abs: Optional[int], collector) -> None:
+        if self.base_bin is None or not self.open_bins:
+            return
+        if closed_before_abs is None:
+            rel_before = max(self.open_bins) + 1
+        else:
+            rel_before = int(closed_before_abs - self.base_bin)
+        if self.emitted_before_rel is None or rel_before > self.emitted_before_rel:
+            self.emitted_before_rel = rel_before
+        closing = sorted(b for b in self.open_bins if b < rel_before)
+        if not closing:
+            return
+        keys, bins, accs = self._aggregator().extract(
+            min(closing), rel_before, rel_before
+        )
+        self.open_bins -= set(closing)
+        if len(keys):
+            self._emit_entries(keys, bins, accs, collector)
+        self.key_dict.evict_closed(rel_before)
+
+    def _emit_entries(self, keys, bins, accs, collector) -> None:
+        from ..ops.aggregate import finalize_aggs
+
+        starts = (bins.astype(np.int64) + self.base_bin) * self.width
+        cols: dict[str, np.ndarray] = {}
+        cols.update(self.key_dict.lookup_columns(keys))
+        cols[WINDOW_START] = starts
+        cols[WINDOW_END] = starts + self.width
+        finals = finalize_aggs([a[1] for a in self.aggregates], accs)
+        for (name, _k, _e), arr in zip(self.aggregates, finals):
+            cols[name] = arr
+        # reference stamps the window start as the output event time
+        cols[TIMESTAMP_FIELD] = starts
+        out = Batch(cols)
+        if self.final_projection is not None:
+            n = out.num_rows
+            proj = {name: eval_expr(e, out.columns, n) for name, e in self.final_projection}
+            if TIMESTAMP_FIELD not in proj:
+                proj[TIMESTAMP_FIELD] = out.timestamps
+            out = Batch(proj)
+        collector.collect(out)
+
+    # ------------------------------------------------------------------
+
+    def handle_checkpoint(self, barrier, ctx, collector):
+        keys, bins, accs = self._aggregator().snapshot()
+        tbl = ctx.table_manager.expiring_time_key("t", self.width)
+        if len(keys) == 0:
+            tbl.replace_all([])
+            return
+        starts = (bins.astype(np.int64) + (self.base_bin or 0)) * self.width
+        cols: dict[str, np.ndarray] = {
+            TIMESTAMP_FIELD: starts,
+            KEY_FIELD: keys,
+        }
+        cols.update(self.key_dict.lookup_columns(keys))
+        for i, a in enumerate(accs):
+            cols[f"__acc_{i}"] = a
+        tbl.replace_all([Batch(cols)])
+
+
+@register_operator(OpName.TUMBLING_AGGREGATE)
+def _make_tumbling(cfg: dict):
+    return TumblingAggregate(cfg)

@@ -1,0 +1,45 @@
+"""Test env: force JAX onto a virtual 8-device CPU platform before any jax
+import, so sharding/collective tests run without TPU hardware (the driver
+separately dry-runs the multi-chip path; bench.py runs on the real chip)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's TPU-tunnel shim (sitecustomize) force-sets
+# jax.config jax_platforms at interpreter startup, which overrides the env
+# var — override it back BEFORE any backend initializes, or every test
+# process contends for the single TPU tunnel and deadlocks.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _storage(tmp_path, monkeypatch):
+    """Point checkpoint storage at a fresh tmp dir for every test."""
+    from arroyo_tpu import config as cfg
+
+    cfg.reset()
+    cfg.update({
+        "checkpoint.storage-url": str(tmp_path / "checkpoints"),
+        # small device tables keep CPU-mode jit compile/exec fast in tests
+        "device.table-capacity": 8192,
+        "device.batch-capacity": 1024,
+        "device.emit-capacity": 1024,
+        "device.max-probes": 32,
+    })
+    yield str(tmp_path / "checkpoints")
+    cfg.reset()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _operators():
+    import arroyo_tpu
+
+    arroyo_tpu._load_operators()

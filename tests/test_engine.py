@@ -1,0 +1,172 @@
+"""End-to-end engine tests with hand-built graphs (reference test strategy
+SURVEY §4.3: watermark merge, hash shuffle, queue backpressure)."""
+
+import numpy as np
+
+from arroyo_tpu.batch import Schema, Field, TIMESTAMP_FIELD
+from arroyo_tpu.engine import Engine, run_graph
+from arroyo_tpu.expr import BinOp, Col, Lit
+from arroyo_tpu.graph import EdgeType, Graph, Node, OpName
+
+DUMMY = Schema.of([("x", "int64"), (TIMESTAMP_FIELD, "int64")])
+
+
+def impulse_to_vec(count=100, parallelism=1, filter_expr=None, value_cfg=None):
+    g = Graph()
+    rows: list = []
+    g.add_node(Node("src", OpName.SOURCE,
+                    {"connector": "impulse", "message_count": count}, parallelism))
+    cfg = value_cfg or {"filter": filter_expr}
+    g.add_node(Node("map", OpName.VALUE, cfg, parallelism))
+    g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": rows}, 1))
+    g.add_edge("src", "map", EdgeType.FORWARD, DUMMY)
+    g.add_edge("map", "sink", EdgeType.SHUFFLE, DUMMY)
+    return g, rows
+
+
+def test_linear_pipeline_completes():
+    g, rows = impulse_to_vec(count=100)
+    run_graph(g, job_id="t1", timeout=30)
+    assert len(rows) == 100
+    counters = sorted(r["counter"] for r in rows)
+    assert counters == list(range(100))
+
+
+def test_filter():
+    f = BinOp("==", BinOp("%", Col("counter"), Lit(2)), Lit(0))
+    g, rows = impulse_to_vec(count=100, filter_expr=f)
+    run_graph(g, job_id="t2", timeout=30)
+    assert sorted(r["counter"] for r in rows) == list(range(0, 100, 2))
+
+
+def test_projection():
+    cfg = {"projections": [("doubled", BinOp("*", Col("counter"), Lit(2)))]}
+    g, rows = impulse_to_vec(count=10, value_cfg=cfg)
+    run_graph(g, job_id="t3", timeout=30)
+    assert sorted(r["doubled"] for r in rows) == list(range(0, 20, 2))
+
+
+def test_parallel_sources_and_shuffle():
+    g, rows = impulse_to_vec(count=50, parallelism=3)
+    run_graph(g, job_id="t4", timeout=30)
+    # 3 subtasks x 50 messages each
+    assert len(rows) == 150
+    by_sub = {}
+    for r in rows:
+        by_sub.setdefault(r["subtask_index"], []).append(r["counter"])
+    assert set(by_sub) == {0, 1, 2}
+    for counters in by_sub.values():
+        assert sorted(counters) == list(range(50))
+
+
+def test_keyed_shuffle_partitions_by_key():
+    g = Graph()
+    rows: list = []
+    g.add_node(Node("src", OpName.SOURCE, {"connector": "impulse", "message_count": 200}, 1))
+    g.add_node(Node("key", OpName.KEY, {"keys": [("k", BinOp("%", Col("counter"), Lit(10)))]}, 1))
+    g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": rows, "include_internal": True}, 4))
+    g.add_edge("src", "key", EdgeType.FORWARD, DUMMY)
+    g.add_edge("key", "sink", EdgeType.SHUFFLE, DUMMY)
+    run_graph(g, job_id="t5", timeout=30)
+    assert len(rows) == 200
+    # all rows with the same key hash must have landed in one partition:
+    # verify hash determinism instead (vec sink loses partition identity),
+    # and that every key appears exactly 20 times
+    from collections import Counter
+
+    c = Counter(r["k"] for r in rows)
+    assert all(v == 20 for v in c.values()) and len(c) == 10
+
+
+def test_checkpoint_and_restore(tmp_path):
+    """Run, checkpoint mid-stream, simulate failure, restore from epoch."""
+    import json, os
+    from arroyo_tpu.config import config
+
+    storage = config().get("checkpoint.storage-url")
+    path = tmp_path / "out.jsonl"
+
+    def build(rows):
+        g = Graph()
+        g.add_node(Node("src", OpName.SOURCE,
+                        {"connector": "impulse", "message_count": 5000, "event_rate": 5000}, 1))
+        g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": rows}, 1))
+        g.add_edge("src", "sink", EdgeType.FORWARD, DUMMY)
+        return g
+
+    rows1: list = []
+    eng = Engine(build(rows1), job_id="ckpt")
+    eng.start()
+    assert eng.checkpoint_and_wait(1, timeout=30)
+    # stop without finishing (simulated failure: discard engine)
+    eng.stop()
+    eng.join(timeout=30)
+    n_before = len(rows1)
+    assert 0 < n_before < 5000
+
+    from arroyo_tpu.state.tables import latest_complete_checkpoint
+
+    assert latest_complete_checkpoint(storage, "ckpt") == 1
+
+    rows2: list = []
+    eng2 = Engine(build(rows2), job_id="ckpt", restore_epoch=1)
+    eng2.run_to_completion(timeout=60)
+    counters2 = sorted(r["counter"] for r in rows2)
+    # restart resumed from the checkpointed offset, not zero
+    assert counters2[0] > 0
+    assert counters2[-1] == 4999
+    # exactly-once relative to the checkpoint: no gaps, no duplicates
+    assert counters2 == list(range(counters2[0], 5000))
+
+
+def test_task_failure_aborts_pipeline_promptly():
+    """A failing operator must tear the pipeline down (sources stopped,
+    inboxes closed) and surface the error from join()."""
+    import time
+    from arroyo_tpu.engine.engine import register_operator
+    from arroyo_tpu.graph import OpName
+    from arroyo_tpu.operators.base import Operator
+
+    class Exploder(Operator):
+        def process_batch(self, batch, ctx, collector, input_index=0):
+            raise RuntimeError("boom in operator")
+
+    register_operator(OpName.ASYNC_UDF)(lambda cfg: Exploder())
+
+    g = Graph()
+    g.add_node(Node("src", OpName.SOURCE,
+                    {"connector": "impulse", "message_count": None, "event_rate": 50000}, 1))
+    g.add_node(Node("bad", OpName.ASYNC_UDF, {}, 1))
+    g.add_edge("src", "bad", EdgeType.FORWARD, DUMMY)
+    eng = Engine(g, job_id="fail")
+    eng.start()
+    t0 = time.monotonic()
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="boom in operator"):
+        eng.join(timeout=30)
+    assert time.monotonic() - t0 < 15  # aborted promptly, not via timeout
+
+
+def test_backpressure_bounded_queue():
+    from arroyo_tpu.engine.queues import TaskInbox
+    from arroyo_tpu.batch import Batch
+    import threading, time
+
+    inbox = TaskInbox(1, row_budget=100)
+    b = Batch({"x": np.arange(60)})
+    inbox.put(0, b)
+    blocked_done = []
+
+    def blocked_put():
+        inbox.put(0, Batch({"x": np.arange(60)}))  # 60+60 > 100 -> blocks
+        blocked_done.append(True)
+
+    t = threading.Thread(target=blocked_put, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not blocked_done
+    idx, item = inbox.get()
+    inbox.release(idx, item)
+    t.join(timeout=5)
+    assert blocked_done

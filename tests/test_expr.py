@@ -1,0 +1,50 @@
+import numpy as np
+
+from arroyo_tpu.expr import BinOp, Case, Cast, Col, Func, Lit, Neg, Not, eval_expr
+
+
+COLS = {
+    "a": np.array([1, 2, 3, 4], dtype=np.int64),
+    "b": np.array([10.0, 20.0, 30.0, 40.0], dtype=np.float64),
+    "s": np.array(["x", "y", "x", "z"], dtype=object),
+}
+
+
+def ev(e):
+    return eval_expr(e, COLS, 4)
+
+
+def test_arithmetic_and_comparison():
+    assert ev(BinOp("+", Col("a"), Lit(1))).tolist() == [2, 3, 4, 5]
+    assert ev(BinOp("*", Col("a"), Col("a"))).tolist() == [1, 4, 9, 16]
+    assert ev(BinOp(">", Col("b"), Lit(25.0))).tolist() == [False, False, True, True]
+    assert ev(BinOp("==", Col("s"), Lit("x"))).tolist() == [True, False, True, False]
+    # SQL integer division truncates
+    assert ev(BinOp("/", Col("a"), Lit(2))).tolist() == [0, 1, 1, 2]
+    assert ev(BinOp("/", Neg(Col("a")), Lit(2))).tolist() == [0, -1, -1, -2]
+
+
+def test_boolean_and_case():
+    e = BinOp("and", BinOp(">", Col("a"), Lit(1)), Not(BinOp("==", Col("s"), Lit("z"))))
+    assert ev(e).tolist() == [False, True, True, False]
+    c = Case(((BinOp(">", Col("a"), Lit(2)), Lit(100)),), Lit(0))
+    assert ev(c).tolist() == [0, 0, 100, 100]
+
+
+def test_functions():
+    assert ev(Func("abs", (Neg(Col("a")),))).tolist() == [1, 2, 3, 4]
+    assert ev(Func("length", (Col("s"),))).tolist() == [1, 1, 1, 1]
+    assert ev(Func("concat", (Col("s"), Lit("!")))).tolist() == ["x!", "y!", "x!", "z!"]
+    assert ev(Func("upper", (Col("s"),))).tolist() == ["X", "Y", "X", "Z"]
+    assert ev(Cast(Col("a"), "float32")).dtype == np.float32
+    assert ev(Cast(Col("a"), "string")).tolist() == ["1", "2", "3", "4"]
+
+
+def test_jnp_matches_numpy():
+    import jax.numpy as jnp
+
+    jcols = {k: jnp.asarray(v) for k, v in COLS.items() if k != "s"}
+    e = BinOp("+", BinOp("*", Col("a"), Lit(3)), Col("b"))
+    np.testing.assert_allclose(np.asarray(e.eval_jnp(jcols)), ev(e))
+    f = Func("abs", (BinOp("-", Col("a"), Lit(2)),))
+    np.testing.assert_allclose(np.asarray(f.eval_jnp(jcols)), ev(f))

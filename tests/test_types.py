@@ -1,0 +1,85 @@
+import numpy as np
+import pytest
+
+from arroyo_tpu.batch import Batch, Schema, Field, TIMESTAMP_FIELD
+from arroyo_tpu.engine.task import WatermarkHolder
+from arroyo_tpu.hashing import hash_column, hash_columns, servers_for_hashes
+from arroyo_tpu.types import (
+    U64_MAX,
+    Watermark,
+    range_for_server,
+    server_for_hash,
+)
+
+
+def test_key_ranges_partition_the_space():
+    for n in (1, 2, 3, 7, 16):
+        ranges = [range_for_server(i, n) for i in range(n)]
+        assert ranges[0][0] == 0
+        assert ranges[-1][1] == U64_MAX
+        for (s0, e0), (s1, e1) in zip(ranges, ranges[1:]):
+            assert e0 + 1 == s1
+        for h in (0, 1, 12345, U64_MAX // 2, U64_MAX - 1, U64_MAX):
+            owner = server_for_hash(h, n)
+            lo, hi = ranges[owner]
+            assert lo <= h <= hi
+
+
+def test_servers_for_hashes_matches_scalar():
+    hashes = np.array([0, 1, 999, U64_MAX // 3, U64_MAX], dtype=np.uint64)
+    for n in (1, 2, 5, 8):
+        vec = servers_for_hashes(hashes, n)
+        for h, s in zip(hashes.tolist(), vec.tolist()):
+            assert s == server_for_hash(h, n)
+
+
+def test_hashing_deterministic_and_spread():
+    col = np.arange(1000, dtype=np.int64)
+    h1, h2 = hash_column(col), hash_column(col)
+    assert (h1 == h2).all()
+    assert len(np.unique(h1)) == 1000
+    servers = servers_for_hashes(h1, 4)
+    counts = np.bincount(servers, minlength=4)
+    assert counts.min() > 150  # roughly uniform
+
+    strs = np.array(["a", "b", "a", None if False else "c"], dtype=object)
+    hs = hash_column(strs)
+    assert hs[0] == hs[2] and hs[0] != hs[1]
+
+    multi = hash_columns([col, col])
+    assert (hash_columns([col, col]) == multi).all()
+    assert not (multi == h1).all()
+
+
+def test_watermark_holder_min_merge_and_idle():
+    h = WatermarkHolder(3)
+    assert h.merged() is None
+    h.set(0, Watermark.event_time(100))
+    h.set(1, Watermark.event_time(50))
+    assert h.merged() is None  # input 2 unseen
+    h.set(2, Watermark.idle())
+    assert h.merged() == Watermark.event_time(50)
+    h.set(1, Watermark.event_time(200))
+    assert h.merged() == Watermark.event_time(100)
+    h.remove(0)
+    assert h.merged() == Watermark.event_time(200)
+    h.set(1, Watermark.idle())
+    h.set(2, Watermark.idle())
+    assert h.merged().is_idle
+
+
+def test_batch_ops():
+    b = Batch({"a": np.array([1, 2, 3]), TIMESTAMP_FIELD: np.array([10, 20, 30])})
+    assert len(b) == 3
+    assert b.filter(np.array([True, False, True])).num_rows == 2
+    assert b.slice(1, 3)["a"].tolist() == [2, 3]
+    c = Batch.concat([b, b])
+    assert c.num_rows == 6
+    with pytest.raises(ValueError):
+        Batch({"a": np.array([1]), "b": np.array([1, 2])})
+
+
+def test_schema_roundtrip():
+    s = Schema.of([("x", "int64"), ("s", "string"), (TIMESTAMP_FIELD, "int64")],
+                  key_fields=("x",), has_keys=True)
+    assert Schema.from_json(s.to_json()) == s
