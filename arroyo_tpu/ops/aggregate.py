@@ -65,6 +65,41 @@ def finalize_aggs(kinds: Sequence[str], acc_arrays: list[np.ndarray]) -> list[np
     return out
 
 
+def drain_extract(extract_once, emit_cap: int, acc_dtypes: Sequence[np.dtype],
+                  emit_lo: int, free_below: int):
+    """Host-side drain loop shared by the single-chip and sharded
+    aggregators. ``extract_once()`` performs one device extraction and
+    returns (key_i64, bin, valid, accs, max_total) as numpy arrays/ints.
+
+    Termination invariants: entries in the emit range are freed only when
+    below ``free_below``, so a destructive close shrinks each round; a pure
+    range scan (free_below <= emit_lo) must bail after one round or it would
+    re-emit the same entries forever."""
+    keys_out, bins_out = [], []
+    accs_out: list[list[np.ndarray]] = [[] for _ in acc_dtypes]
+    while True:
+        k, b, valid, accs, max_total = extract_once()
+        cnt = int(valid.sum())
+        if cnt:
+            keys_out.append(k[valid])
+            bins_out.append(b[valid])
+            for i, a in enumerate(accs):
+                accs_out[i].append(a[valid])
+        if max_total <= emit_cap or cnt == 0 or free_below <= emit_lo:
+            break
+    if not keys_out:
+        return (
+            np.empty(0, dtype=np.uint64),
+            np.empty(0, dtype=np.int32),
+            [np.empty(0, dtype=d) for d in acc_dtypes],
+        )
+    return (
+        np.concatenate(keys_out).view(np.uint64),
+        np.concatenate(bins_out),
+        [np.concatenate(a) for a in accs_out],
+    )
+
+
 def _identity(kind: str, dtype):
     if kind in ("sum", "count"):
         return np.array(0, dtype=dtype)
@@ -76,8 +111,110 @@ def _identity(kind: str, dtype):
 
 
 # =========================================================================
-# jax backend
+# jax backend — traceable building blocks (shared by the single-chip step
+# and the shard_map'd multi-chip step in arroyo_tpu.parallel)
 # =========================================================================
+
+
+def _combine_jnp(kind, a, b):
+    import jax.numpy as jnp
+
+    if kind in ("sum", "count"):
+        return a + b
+    if kind == "min":
+        return jnp.minimum(a, b)
+    return jnp.maximum(a, b)
+
+
+def _seg_reduce_jnp(kind, vals, seg, valid, num_segments):
+    import jax
+    import jax.numpy as jnp
+
+    if kind in ("sum", "count"):
+        v = jnp.where(valid, vals, 0)
+        return jax.ops.segment_sum(v, seg, num_segments=num_segments)
+    if kind == "min":
+        v = jnp.where(valid, vals, _identity("min", np.dtype(vals.dtype)))
+        return jax.ops.segment_min(v, seg, num_segments=num_segments)
+    v = jnp.where(valid, vals, _identity("max", np.dtype(vals.dtype)))
+    return jax.ops.segment_max(v, seg, num_segments=num_segments)
+
+
+def sort_reduce(acc_kinds, key, bins, valid, vals, batch_cap):
+    """Collapse a padded batch to unique (bin, key) partials: lexsort so
+    duplicates are adjacent, then segment-reduce each accumulator. Returns
+    (u_key, u_bin, active_mask, u_accs), all of length batch_cap."""
+    import jax
+    import jax.numpy as jnp
+
+    skey = jnp.where(valid, key, _I64_MAX)
+    sbin = jnp.where(valid, bins, _I32_MAX)
+    order = jnp.lexsort((sbin, skey))
+    k_s = skey[order]
+    b_s = sbin[order]
+    valid_s = valid[order]
+    newseg = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), (k_s[1:] != k_s[:-1]) | (b_s[1:] != b_s[:-1])]
+    )
+    seg = jnp.cumsum(newseg) - 1
+    u_accs = tuple(
+        _seg_reduce_jnp(acc_kinds[i], vals[i][order], seg, valid_s, batch_cap)
+        for i in range(len(acc_kinds))
+    )
+    rows_per_seg = jax.ops.segment_sum(
+        valid_s.astype(jnp.int32), seg, num_segments=batch_cap
+    )
+    # representative key/bin per segment (all rows in a segment are equal)
+    u_key = jax.ops.segment_max(k_s, seg, num_segments=batch_cap)
+    u_bin = jax.ops.segment_max(b_s, seg, num_segments=batch_cap)
+    return u_key, u_bin, rows_per_seg > 0, u_accs
+
+
+def probe_merge(acc_kinds, table, u_key, u_bin, active0, u_accs, cap, max_probes):
+    """Merge unique partials into the (keys, bins, occ, accs) hash table with
+    linear probing; empty-slot claim races resolved via scatter-max of the
+    contender index. Returns (table', still_active_mask)."""
+    import jax
+    import jax.numpy as jnp
+
+    keys_t, bins_t, occ_t, accs_t = table
+    mask_cap = cap - 1
+    n_acc = len(acc_kinds)
+    batch_cap = u_key.shape[0]
+
+    z = u_key.astype(jnp.uint64) ^ (u_bin.astype(jnp.uint64) * jnp.uint64(0xFF51AFD7ED558CCD))
+    z = (z ^ (z >> jnp.uint64(33))) * jnp.uint64(0xC4CEB9FE1A85EC53)
+    z = z ^ (z >> jnp.uint64(33))
+    h0 = (z & jnp.uint64(mask_cap)).astype(jnp.int32)
+    seg_pos = jnp.arange(batch_cap, dtype=jnp.int32)
+
+    def probe(i, carry):
+        keys_c, bins_c, occ_c, accs_c, active = carry
+        cand = (h0 + i) & mask_cap
+        cur_key = keys_c[cand]
+        cur_bin = bins_c[cand]
+        cur_occ = occ_c[cand]
+        match = active & cur_occ & (cur_key == u_key) & (cur_bin == u_bin)
+        empty_here = active & ~cur_occ
+        claim_idx = jnp.where(empty_here, cand, cap)
+        claims = jnp.full(cap, -1, dtype=jnp.int32).at[claim_idx].max(seg_pos, mode="drop")
+        won = empty_here & (claims[cand] == seg_pos)
+        write = match | won
+        safe = jnp.where(write, cand, cap)
+        keys_c = keys_c.at[safe].set(u_key, mode="drop")
+        bins_c = bins_c.at[safe].set(u_bin, mode="drop")
+        occ_c = occ_c.at[safe].set(True, mode="drop")
+        new_accs = []
+        for j in range(n_acc):
+            merged = _combine_jnp(acc_kinds[j], accs_c[j][cand], u_accs[j])
+            val = jnp.where(match, merged, u_accs[j])
+            new_accs.append(accs_c[j].at[safe].set(val, mode="drop"))
+        return (keys_c, bins_c, occ_c, tuple(new_accs), active & ~write)
+
+    keys_t, bins_t, occ_t, accs_t, still_active = jax.lax.fori_loop(
+        0, max_probes, probe, (keys_t, bins_t, occ_t, accs_t, active0)
+    )
+    return (keys_t, bins_t, occ_t, accs_t), still_active
 
 
 @functools.lru_cache(maxsize=None)
@@ -86,85 +223,17 @@ def _build_jax(acc_kinds: tuple[str, ...], acc_dtypes: tuple, cap: int, batch_ca
     import jax
     import jax.numpy as jnp
 
-    n_acc = len(acc_kinds)
     mask_cap = cap - 1
     assert cap & mask_cap == 0, "table capacity must be a power of two"
 
-    def seg_reduce(kind, vals, seg, valid):
-        if kind in ("sum", "count"):
-            v = jnp.where(valid, vals, 0)
-            return jax.ops.segment_sum(v, seg, num_segments=batch_cap)
-        if kind == "min":
-            v = jnp.where(valid, vals, _identity("min", np.dtype(vals.dtype)))
-            return jax.ops.segment_min(v, seg, num_segments=batch_cap)
-        v = jnp.where(valid, vals, _identity("max", np.dtype(vals.dtype)))
-        return jax.ops.segment_max(v, seg, num_segments=batch_cap)
-
-    def combine(kind, a, b):
-        if kind in ("sum", "count"):
-            return a + b
-        if kind == "min":
-            return jnp.minimum(a, b)
-        return jnp.maximum(a, b)
-
-    def slot_hash(key, bins):
-        z = key.astype(jnp.uint64) ^ (bins.astype(jnp.uint64) * jnp.uint64(0xFF51AFD7ED558CCD))
-        z = (z ^ (z >> jnp.uint64(33))) * jnp.uint64(0xC4CEB9FE1A85EC53)
-        z = z ^ (z >> jnp.uint64(33))
-        return (z & jnp.uint64(mask_cap)).astype(jnp.int32)
-
     def step(state, key, bins, valid, vals):
         keys_t, bins_t, occ_t, accs_t, oflow_t = state
-        # ---- 1. sort so duplicate (bin, key) pairs are adjacent
-        skey = jnp.where(valid, key, _I64_MAX)
-        sbin = jnp.where(valid, bins, _I32_MAX)
-        order = jnp.lexsort((sbin, skey))
-        k_s = skey[order]
-        b_s = sbin[order]
-        valid_s = valid[order]
-        newseg = jnp.concatenate(
-            [jnp.ones(1, dtype=bool),
-             (k_s[1:] != k_s[:-1]) | (b_s[1:] != b_s[:-1])]
+        u_key, u_bin, active0, u_accs = sort_reduce(
+            acc_kinds, key, bins, valid, vals, batch_cap
         )
-        seg = jnp.cumsum(newseg) - 1
-        # ---- 2. segment-reduce each accumulator
-        u_accs = tuple(
-            seg_reduce(acc_kinds[i], vals[i][order], seg, valid_s) for i in range(n_acc)
-        )
-        rows_per_seg = jax.ops.segment_sum(valid_s.astype(jnp.int32), seg, num_segments=batch_cap)
-        # representative key/bin per segment (all rows in a segment are equal)
-        u_key = jax.ops.segment_max(k_s, seg, num_segments=batch_cap)
-        u_bin = jax.ops.segment_max(b_s, seg, num_segments=batch_cap)
-        active0 = rows_per_seg > 0
-        # ---- 3. probing merge into the table
-        h0 = slot_hash(u_key, u_bin)
-        seg_pos = jnp.arange(batch_cap, dtype=jnp.int32)
-
-        def probe(i, carry):
-            keys_c, bins_c, occ_c, accs_c, active = carry
-            cand = (h0 + i) & mask_cap
-            cur_key = keys_c[cand]
-            cur_bin = bins_c[cand]
-            cur_occ = occ_c[cand]
-            match = active & cur_occ & (cur_key == u_key) & (cur_bin == u_bin)
-            empty_here = active & ~cur_occ
-            claim_idx = jnp.where(empty_here, cand, cap)
-            claims = jnp.full(cap, -1, dtype=jnp.int32).at[claim_idx].max(seg_pos, mode="drop")
-            won = empty_here & (claims[cand] == seg_pos)
-            write = match | won
-            safe = jnp.where(write, cand, cap)
-            keys_c = keys_c.at[safe].set(u_key, mode="drop")
-            bins_c = bins_c.at[safe].set(u_bin, mode="drop")
-            occ_c = occ_c.at[safe].set(True, mode="drop")
-            new_accs = []
-            for j in range(n_acc):
-                merged = combine(acc_kinds[j], accs_c[j][cand], u_accs[j])
-                val = jnp.where(match, merged, u_accs[j])
-                new_accs.append(accs_c[j].at[safe].set(val, mode="drop"))
-            return (keys_c, bins_c, occ_c, tuple(new_accs), active & ~write)
-
-        keys_t, bins_t, occ_t, accs_t, still_active = jax.lax.fori_loop(
-            0, max_probes, probe, (keys_t, bins_t, occ_t, accs_t, active0)
+        (keys_t, bins_t, occ_t, accs_t), still_active = probe_merge(
+            acc_kinds, (keys_t, bins_t, occ_t, accs_t),
+            u_key, u_bin, active0, u_accs, cap, max_probes,
         )
         # overflow accumulates in device state; the host checks it at the
         # next extract/snapshot boundary instead of syncing every batch
@@ -319,37 +388,18 @@ class DeviceHashAggregator:
         if self.backend == "numpy":
             return self._extract_numpy(emit_lo, emit_hi, free_below)
         self._check_overflow()
-        keys_out, bins_out, accs_out = [], [], [[] for _ in self.acc_kinds]
-        while True:
+
+        def extract_once():
             self.state, (k, b, valid, accs, total) = self._extract(
                 self.state, np.int32(emit_lo), np.int32(emit_hi), np.int32(free_below)
             )
-            valid = np.asarray(valid)
-            cnt = valid.sum()
-            if cnt:
-                keys_out.append(np.asarray(k)[valid])
-                bins_out.append(np.asarray(b)[valid])
-                for i, a in enumerate(accs):
-                    accs_out[i].append(np.asarray(a)[valid])
-            total = int(total)
-            if total <= self.emit_cap or cnt == 0:
-                break
-            # more closed entries than emit_cap: emitted ones were freed only
-            # if below free_below; for range scans everything fit emit_cap
-            if free_below <= emit_lo:
-                break
-        if not keys_out:
-            empty = [np.empty(0, dtype=d) for d in self.acc_dtypes]
             return (
-                np.empty(0, dtype=np.uint64),
-                np.empty(0, dtype=np.int32),
-                empty,
+                np.asarray(k), np.asarray(b), np.asarray(valid),
+                [np.asarray(a) for a in accs], int(total),
             )
-        return (
-            np.concatenate(keys_out).view(np.uint64),
-            np.concatenate(bins_out),
-            [np.concatenate(a) for a in accs_out],
-        )
+
+        return drain_extract(extract_once, self.emit_cap, self.acc_dtypes,
+                             emit_lo, free_below)
 
     def _extract_numpy(self, emit_lo, emit_hi, free_below):
         ks, bs, accs = [], [], [[] for _ in self.acc_kinds]

@@ -1,0 +1,274 @@
+"""Multi-chip keyed window aggregation: shard_map over a device mesh.
+
+This replaces the reference's repartition shuffle (hash keys -> sort ->
+slice per destination -> TCP, crates/arroyo-operator/src/context.rs:502-556 +
+arroyo-worker/src/network_manager.rs) with an in-program exchange over ICI:
+
+  per device (shard_map over the "data" mesh axis):
+    1. sort_reduce the LOCAL micro-batch -> unique (bin, key) partials
+       (pre-aggregation before the wire, like the reference's partial plans)
+    2. owner = key-range map (same contiguous u64 ranges as
+       arroyo-types/src/lib.rs:621 server_for_hash, so host and device
+       agree on ownership)
+    3. bucket partials into a fixed [n_dev, per_dest_cap] send buffer
+       (sort by owner + rank-in-owner scatter, drop+count overflow)
+    4. jax.lax.all_to_all over the mesh axis  <- the ICI shuffle
+    5. sort_reduce the received rows (combining duplicates of the same
+       (bin, key) arriving from different shards)
+    6. probe_merge into this device's HBM hash-table shard
+
+  The whole thing is ONE jitted XLA program per step: hashing, partials,
+  exchange, and state update all fuse; XLA schedules the all_to_all on ICI.
+
+State layout: every table array gains a leading mesh dimension
+[n_dev, cap] sharded on the "data" axis; extraction (window close) is a
+per-shard compaction producing [n_dev, emit_cap] outputs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..ops.aggregate import _identity, drain_extract, probe_merge, sort_reduce
+from .mesh import KEY_AXIS
+
+_U64_MAX = (1 << 64) - 1
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+class ShardedAggregator:
+    """Key-space-sharded (bin, key) -> accumulators store over a mesh.
+
+    update_sharded: [n_dev, B]-shaped per-device batches -> one fused step
+    (local partials + all_to_all + merge). extract_all: per-shard compaction
+    of closed bins, gathered to host.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        acc_kinds: Sequence[str],
+        acc_dtypes: Sequence[np.dtype],
+        cap: int = 65536,
+        batch_cap: int = 8192,
+        per_dest_cap: Optional[int] = None,
+        max_probes: int = 64,
+        emit_cap: int = 8192,
+    ):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as PS
+
+        self.mesh = mesh
+        self.n_dev = int(mesh.devices.size)
+        self.acc_kinds = tuple(acc_kinds)
+        self.acc_dtypes = tuple(np.dtype(d) for d in acc_dtypes)
+        self.cap = cap
+        self.batch_cap = batch_cap
+        # room for skew: by default each destination can receive up to half
+        # the local batch from every source shard
+        self.per_dest_cap = per_dest_cap or max(batch_cap // max(self.n_dev // 2, 1), 64)
+        self.max_probes = max_probes
+        self.emit_cap = emit_cap
+
+        n_dev = self.n_dev
+        dest_cap = self.per_dest_cap
+        acc_kinds_t = self.acc_kinds
+        acc_dtypes_t = self.acc_dtypes
+        recv_cap = n_dev * dest_cap
+
+        def unpack(state):
+            keys_t, bins_t, occ_t, accs_t, oflow_t = state
+            return (
+                keys_t[0], bins_t[0], occ_t[0],
+                tuple(a[0] for a in accs_t), oflow_t[0],
+            )
+
+        def pack(keys_t, bins_t, occ_t, accs_t, oflow_t):
+            return (
+                keys_t[None], bins_t[None], occ_t[None],
+                tuple(a[None] for a in accs_t), oflow_t[None],
+            )
+
+        def local_step(state, key, bins, valid, vals):
+            """Per-device body under shard_map (leading mesh dim is 1)."""
+            keys_t, bins_t, occ_t, accs_t, oflow_t = unpack(state)
+            key, bins, valid = key[0], bins[0], valid[0]
+            vals = tuple(v[0] for v in vals)
+            # --- 1. local pre-aggregation
+            u_key, u_bin, active, u_accs = sort_reduce(
+                acc_kinds_t, key, bins, valid, vals, batch_cap
+            )
+            # --- 2. owners via contiguous u64 ranges (matching host
+            # servers_for_hashes, including its n == 1 special case —
+            # _U64_MAX // 1 + 1 would overflow uint64)
+            if n_dev == 1:
+                owner = jnp.zeros(batch_cap, dtype=jnp.int32)
+            else:
+                range_size = jnp.uint64(_U64_MAX // n_dev + 1)
+                owner = jnp.minimum(
+                    u_key.astype(jnp.uint64) // range_size, jnp.uint64(n_dev - 1)
+                ).astype(jnp.int32)
+            owner = jnp.where(active, owner, n_dev)  # sentinel sorts last
+            # --- 3. bucket into [n_dev * dest_cap] send buffers
+            order = jnp.argsort(owner)
+            o_s = owner[order]
+            starts = jnp.searchsorted(o_s, jnp.arange(n_dev, dtype=jnp.int32))
+            rank = jnp.arange(batch_cap, dtype=jnp.int32) - starts[
+                jnp.clip(o_s, 0, n_dev - 1)
+            ]
+            sendable = (o_s < n_dev) & (rank < dest_cap)
+            slot = jnp.where(sendable, o_s * dest_cap + rank, recv_cap)
+            dropped = jnp.sum((o_s < n_dev) & (rank >= dest_cap), dtype=jnp.int32)
+
+            def scatter(src, fill):
+                buf = jnp.full((recv_cap,), fill, dtype=src.dtype)
+                return buf.at[slot].set(src[order], mode="drop")
+
+            s_key = scatter(u_key, jnp.int64(0))
+            s_bin = scatter(u_bin, jnp.int32(0))
+            s_valid = jnp.zeros((recv_cap,), dtype=bool).at[slot].set(
+                sendable, mode="drop"
+            )
+            s_accs = tuple(
+                scatter(u_accs[i], jnp.asarray(_identity(acc_kinds_t[i], acc_dtypes_t[i])))
+                for i in range(len(acc_kinds_t))
+            )
+
+            # --- 4. ICI exchange
+            def a2a(x):
+                return jax.lax.all_to_all(
+                    x.reshape(n_dev, dest_cap, *x.shape[1:]),
+                    KEY_AXIS, split_axis=0, concat_axis=0,
+                ).reshape(recv_cap, *x.shape[1:])
+
+            r_key = a2a(s_key)
+            r_bin = a2a(s_bin)
+            r_valid = a2a(s_valid)
+            r_accs = tuple(a2a(a) for a in s_accs)
+            # --- 5. combine duplicates across source shards
+            c_key, c_bin, c_active, c_accs = sort_reduce(
+                acc_kinds_t, r_key, r_bin, r_valid, r_accs, recv_cap
+            )
+            # --- 6. merge into the local table shard
+            (keys_t, bins_t, occ_t, accs_t), still_active = probe_merge(
+                acc_kinds_t, (keys_t, bins_t, occ_t, accs_t),
+                c_key, c_bin, c_active, c_accs, cap, max_probes,
+            )
+            oflow_t = oflow_t + jnp.sum(still_active, dtype=jnp.int32) + dropped
+            return pack(keys_t, bins_t, occ_t, accs_t, oflow_t)
+
+        spec_state = (
+            PS(KEY_AXIS, None), PS(KEY_AXIS, None), PS(KEY_AXIS, None),
+            tuple(PS(KEY_AXIS, None) for _ in self.acc_kinds), PS(KEY_AXIS),
+        )
+        spec_batch = PS(KEY_AXIS, None)
+        self._step = jax.jit(
+            _shard_map(
+                local_step, mesh,
+                in_specs=(spec_state, spec_batch, spec_batch, spec_batch,
+                          tuple(spec_batch for _ in self.acc_kinds)),
+                out_specs=spec_state,
+            ),
+            donate_argnums=0,
+        )
+
+        emit_cap_ = self.emit_cap
+
+        def local_extract(state, emit_lo, emit_hi, free_below):
+            keys_t, bins_t, occ_t, accs_t, oflow_t = unpack(state)
+            emit_mask = occ_t & (bins_t >= emit_lo) & (bins_t < emit_hi)
+            total = jnp.sum(emit_mask, dtype=jnp.int32)
+            order = jnp.argsort(~emit_mask)
+            sel = order[:emit_cap_]
+            out_valid = emit_mask[sel]
+            out_key = keys_t[sel]
+            out_bin = bins_t[sel]
+            out_accs = tuple(a[sel] for a in accs_t)
+            free_mask = occ_t & (bins_t < free_below) & ~emit_mask
+            emitted_free = out_valid & (out_bin < free_below)
+            occ_t = occ_t & ~free_mask
+            occ_t = occ_t.at[jnp.where(emitted_free, sel, cap)].set(False, mode="drop")
+            return (
+                pack(keys_t, bins_t, occ_t, accs_t, oflow_t),
+                (out_key[None], out_bin[None], out_valid[None],
+                 tuple(a[None] for a in out_accs), total[None]),
+            )
+
+        spec_out = (
+            PS(KEY_AXIS, None), PS(KEY_AXIS, None), PS(KEY_AXIS, None),
+            tuple(PS(KEY_AXIS, None) for _ in self.acc_kinds), PS(KEY_AXIS),
+        )
+        self._extract = jax.jit(
+            _shard_map(
+                local_extract, mesh,
+                in_specs=(spec_state, PS(), PS(), PS()),
+                out_specs=(spec_state, spec_out),
+            ),
+            donate_argnums=0,
+        )
+        self.state = self._init_state()
+
+    def _init_state(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        shard = NamedSharding(self.mesh, PS(KEY_AXIS, None))
+        shard1 = NamedSharding(self.mesh, PS(KEY_AXIS))
+        n, cap = self.n_dev, self.cap
+        return (
+            jax.device_put(jnp.zeros((n, cap), dtype=jnp.int64), shard),
+            jax.device_put(jnp.zeros((n, cap), dtype=jnp.int32), shard),
+            jax.device_put(jnp.zeros((n, cap), dtype=bool), shard),
+            tuple(
+                jax.device_put(jnp.full((n, cap), _identity(k, d), dtype=d), shard)
+                for k, d in zip(self.acc_kinds, self.acc_dtypes)
+            ),
+            jax.device_put(jnp.zeros((n,), dtype=jnp.int32), shard1),
+        )
+
+    # ------------------------------------------------------------------
+
+    def update_sharded(self, key_i64, bins, valid, vals) -> None:
+        """key_i64/bins/valid: [n_dev, batch_cap] (device-local rows);
+        vals: one [n_dev, batch_cap] array per accumulator."""
+        self.state = self._step(self.state, key_i64, bins, valid, tuple(vals))
+
+    def extract_all(self, emit_lo: int, emit_hi: int, free_below: int):
+        """Close bins across all shards; returns host (key_u64, bin, accs).
+        Drains per emit_cap chunk until every shard is empty; shard outputs
+        are [n_dev, emit_cap] and flattened before the shared drain logic."""
+
+        def extract_once():
+            self.state, (k, b, v, accs, total) = self._extract(
+                self.state, np.int32(emit_lo), np.int32(emit_hi), np.int32(free_below)
+            )
+            return (
+                np.asarray(k).reshape(-1),
+                np.asarray(b).reshape(-1),
+                np.asarray(v).reshape(-1),
+                [np.asarray(a).reshape(-1) for a in accs],
+                int(np.asarray(total).max()),
+            )
+
+        out = drain_extract(extract_once, self.emit_cap, self.acc_dtypes,
+                            emit_lo, free_below)
+        overflow = int(np.asarray(self.state[4]).sum())
+        if overflow > 0:
+            raise RuntimeError(
+                f"sharded aggregate overflow ({overflow} entries dropped) — raise "
+                f"table capacity or per_dest_cap"
+            )
+        return out

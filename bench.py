@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Round benchmark: Nexmark q7-style windowed aggregate throughput.
+
+Pipeline (the BASELINE.md north-star shape): nexmark bid stream ->
+filter/project -> expression watermark -> key by auction -> 10s tumbling
+MAX(price)+COUNT -> blackhole sink. Runs the full framework (vectorized
+generator, host engine, device aggregation steps) on the default platform
+(the real TPU chip under the driver), then the identical pipeline on the
+pure-NumPy aggregation backend as the CPU baseline proxy.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_graph(rows_sink, backend: str, event_count: int):
+    from arroyo_tpu.batch import TIMESTAMP_FIELD, Schema
+    from arroyo_tpu.expr import Col
+    from arroyo_tpu.graph import EdgeType, Graph, Node, OpName
+
+    S = Schema.of([("x", "int64"), (TIMESTAMP_FIELD, "int64")])
+    g = Graph()
+    g.add_node(Node("src", OpName.SOURCE, {
+        "connector": "nexmark", "event_count": event_count,
+        "inter_event_micros": 1000, "first_event_micros": 0,
+        "include_strings": False}, 1))
+    g.add_node(Node("bids", OpName.VALUE, {
+        "projections": [("auction", Col("bid.auction")), ("price", Col("bid.price"))],
+        "filter": Col("bid")}, 1))
+    g.add_node(Node("wm", OpName.WATERMARK, {"expr": Col(TIMESTAMP_FIELD)}, 1))
+    g.add_node(Node("key", OpName.KEY, {"keys": [("auction", Col("auction"))]}, 1))
+    g.add_node(Node("agg", OpName.TUMBLING_AGGREGATE, {
+        "width_micros": 10_000_000,
+        "key_fields": ["auction"],
+        "aggregates": [("max_price", "max", Col("price")), ("bids", "count", None)],
+        "input_dtype_of": lambda e: np.dtype(np.int64),
+        "backend": backend}, 1))
+    g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": rows_sink}, 1))
+    g.add_edge("src", "bids", EdgeType.FORWARD, S)
+    g.add_edge("bids", "wm", EdgeType.FORWARD, S)
+    g.add_edge("wm", "key", EdgeType.FORWARD, S)
+    g.add_edge("key", "agg", EdgeType.SHUFFLE, S)
+    g.add_edge("agg", "sink", EdgeType.FORWARD, S)
+    return g
+
+
+def run_once(backend: str, event_count: int) -> tuple[float, int, list]:
+    from arroyo_tpu.engine import run_graph
+
+    rows: list = []
+    g = build_graph(rows, backend, event_count)
+    t0 = time.perf_counter()
+    run_graph(g, job_id=f"bench-{backend}", timeout=1800)
+    wall = time.perf_counter() - t0
+    return wall, event_count, rows
+
+
+def main() -> None:
+    if os.environ.get("ARROYO_BENCH_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["ARROYO_BENCH_PLATFORM"])
+    import arroyo_tpu
+    from arroyo_tpu import config as cfg
+
+    arroyo_tpu._load_operators()
+    cfg.update({
+        "pipeline.source-batch-size": 8192,
+        "device.batch-capacity": 8192,
+        "device.table-capacity": 65536,
+        "device.emit-capacity": 8192,
+        "checkpoint.storage-url": "/tmp/arroyo-tpu-bench/checkpoints",
+    })
+
+    events = int(os.environ.get("ARROYO_BENCH_EVENTS", 2_000_000))
+    base_events = int(os.environ.get("ARROYO_BENCH_BASELINE_EVENTS", 500_000))
+
+    # warm-up: compile the device step on small input
+    w_wall, _, _ = run_once("jax", 50_000)
+    print(f"# warmup (compile): {w_wall:.1f}s", file=sys.stderr)
+
+    wall, n, rows = run_once("jax", events)
+    eps = n / wall
+    expected_bids = int(n * 46 / 50)
+    got_bids = sum(r["bids"] for r in rows)
+    assert got_bids == expected_bids, f"parity failure: {got_bids} != {expected_bids}"
+    print(f"# tpu-path: {n} events in {wall:.2f}s = {eps:,.0f} events/s; "
+          f"{len(rows)} windows, parity OK", file=sys.stderr)
+
+    b_wall, b_n, b_rows = run_once("numpy", base_events)
+    b_eps = b_n / b_wall
+    assert sum(r["bids"] for r in b_rows) == int(b_n * 46 / 50)
+    print(f"# numpy-baseline: {b_n} events in {b_wall:.2f}s = {b_eps:,.0f} events/s",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "nexmark_q7_tumbling_max_events_per_sec_per_chip",
+        "value": round(eps, 1),
+        "unit": "events/s",
+        "vs_baseline": round(eps / b_eps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
