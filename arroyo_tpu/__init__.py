@@ -25,4 +25,4 @@ def _load_operators() -> None:
     from .operators import builtin  # noqa: F401
 
     connectors.load_all()
-    from .windows import tumbling  # noqa: F401
+    from .windows import session, sliding, tumbling  # noqa: F401

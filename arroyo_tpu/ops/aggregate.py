@@ -100,6 +100,36 @@ def drain_extract(extract_once, emit_cap: int, acc_dtypes: Sequence[np.dtype],
     )
 
 
+def combine_by_key(
+    acc_kinds: Sequence[str], keys: np.ndarray, accs: list[np.ndarray]
+) -> tuple[np.ndarray, list[np.ndarray]]:
+    """Combine per-bin partials that share a key into one accumulator row per
+    key (the sliding-window finish step: width/slide partial bins collapse to
+    one output row — reference sliding_aggregating_window.rs:116-170). Host
+    numpy: the input is already reduced to distinct (bin, key) pairs, so this
+    is small relative to the event stream the device reduced."""
+    if len(keys) == 0:
+        return keys, accs
+    signed = keys.view(np.int64)
+    order = np.argsort(signed, kind="stable")
+    k_s = signed[order]
+    newseg = np.ones(len(k_s), dtype=bool)
+    newseg[1:] = k_s[1:] != k_s[:-1]
+    starts = np.flatnonzero(newseg)
+    out_keys = k_s[starts].view(np.uint64)
+    out_accs = []
+    for kind, a in zip(acc_kinds, accs):
+        a_s = a[order]
+        if kind in ("sum", "count"):
+            red = np.add.reduceat(a_s, starts)
+        elif kind == "min":
+            red = np.minimum.reduceat(a_s, starts)
+        else:
+            red = np.maximum.reduceat(a_s, starts)
+        out_accs.append(red.astype(a.dtype))
+    return out_keys, out_accs
+
+
 def _identity(kind: str, dtype):
     if kind in ("sum", "count"):
         return np.array(0, dtype=dtype)
@@ -240,6 +270,25 @@ def _build_jax(acc_kinds: tuple[str, ...], acc_dtypes: tuple, cap: int, batch_ca
         oflow_t = oflow_t + jnp.sum(still_active, dtype=jnp.int32)
         return (keys_t, bins_t, occ_t, accs_t, oflow_t)
 
+    def scan(state, emit_lo, emit_hi, chunk_start):
+        """Non-destructive position-chunked read of entries with
+        emit_lo <= bin < emit_hi. The host walks chunk_start over
+        range(0, cap, emit_cap) so a range larger than emit_cap is never
+        truncated (sliding-window combine reads the same bins repeatedly)."""
+        keys_t, bins_t, occ_t, accs_t, _oflow = state
+        sel = chunk_start + jnp.arange(emit_cap, dtype=jnp.int32)
+        # out-of-bounds gathers clamp to cap-1 under jit, which would emit the
+        # last slot once per clamped index when emit_cap doesn't divide cap
+        in_bounds = sel < cap
+        out_valid = in_bounds & occ_t[sel] & (bins_t[sel] >= emit_lo) & (bins_t[sel] < emit_hi)
+        return keys_t[sel], bins_t[sel], out_valid, tuple(a[sel] for a in accs_t)
+
+    def free(state, below):
+        """Drop every entry with bin < below (sliding-window retention)."""
+        keys_t, bins_t, occ_t, accs_t, oflow_t = state
+        occ_t = occ_t & ~(bins_t < below)
+        return (keys_t, bins_t, occ_t, accs_t, oflow_t)
+
     def extract(state, emit_lo, emit_hi, free_below):
         """Emit occupied entries with emit_lo <= bin < emit_hi (compacted to
         emit_cap rows); free entries with bin < free_below."""
@@ -263,7 +312,9 @@ def _build_jax(acc_kinds: tuple[str, ...], acc_dtypes: tuple, cap: int, batch_ca
 
     step_j = jax.jit(step, donate_argnums=0)
     extract_j = jax.jit(extract, donate_argnums=0)
-    return step_j, extract_j
+    scan_j = jax.jit(scan)
+    free_j = jax.jit(free, donate_argnums=0)
+    return step_j, extract_j, scan_j, free_j
 
 
 # =========================================================================
@@ -297,7 +348,7 @@ class DeviceHashAggregator:
         self.emit_cap = emit_cap
         self.backend = backend
         if backend == "jax":
-            self._step, self._extract = _build_jax(
+            self._step, self._extract, self._scan, self._free = _build_jax(
                 self.acc_kinds, self.acc_dtypes, cap, batch_cap, max_probes, emit_cap
             )
             self.state = self._init_jax_state()
@@ -400,6 +451,56 @@ class DeviceHashAggregator:
 
         return drain_extract(extract_once, self.emit_cap, self.acc_dtypes,
                              emit_lo, free_below)
+
+    def scan_range(self, emit_lo: int, emit_hi: int) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
+        """Non-destructive read of every entry with bin in [emit_lo, emit_hi)
+        — the sliding-window combine path (a bin participates in width/slide
+        windows, so reads must not free)."""
+        if self.backend == "numpy":
+            ks, bs, accs = [], [], [[] for _ in self.acc_kinds]
+            for (b, k), parts in self.store.items():
+                if emit_lo <= b < emit_hi:
+                    ks.append(k)
+                    bs.append(b)
+                    for i, p in enumerate(parts):
+                        accs[i].append(p)
+            return (
+                np.array(ks, dtype=np.int64).view(np.uint64) if ks else np.empty(0, dtype=np.uint64),
+                np.array(bs, dtype=np.int32),
+                [np.array(a, dtype=d) for a, d in zip(accs, self.acc_dtypes)],
+            )
+        self._check_overflow()
+        keys_out, bins_out = [], []
+        accs_out: list[list[np.ndarray]] = [[] for _ in self.acc_dtypes]
+        for chunk in range(0, self.cap, self.emit_cap):
+            k, b, valid, accs = self._scan(
+                self.state, np.int32(emit_lo), np.int32(emit_hi), np.int32(chunk)
+            )
+            valid = np.asarray(valid)
+            if valid.any():
+                keys_out.append(np.asarray(k)[valid])
+                bins_out.append(np.asarray(b)[valid])
+                for i, a in enumerate(accs):
+                    accs_out[i].append(np.asarray(a)[valid])
+        if not keys_out:
+            return (
+                np.empty(0, dtype=np.uint64),
+                np.empty(0, dtype=np.int32),
+                [np.empty(0, dtype=d) for d in self.acc_dtypes],
+            )
+        return (
+            np.concatenate(keys_out).view(np.uint64),
+            np.concatenate(bins_out),
+            [np.concatenate(a) for a in accs_out],
+        )
+
+    def free_bins_below(self, below: int) -> None:
+        """Drop all entries with bin < below."""
+        if self.backend == "numpy":
+            for kk in [kk for kk in self.store if kk[0] < below]:
+                del self.store[kk]
+            return
+        self.state = self._free(self.state, np.int32(below))
 
     def _extract_numpy(self, emit_lo, emit_hi, free_below):
         ks, bs, accs = [], [], [[] for _ in self.acc_kinds]

@@ -21,10 +21,17 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Optional
 
 import numpy as np
+
+# pyarrow's internal IO thread pool has shown flaky segfaults when many
+# engine task threads write checkpoints while another engine restores in the
+# same process (the smoke-test pattern); parquet IO is off the hot path, so
+# serialize it and keep arrow single-threaded.
+_PARQUET_IO_LOCK = threading.Lock()
 
 from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch, Schema
 from ..types import TaskInfo
@@ -123,7 +130,8 @@ class ExpiringTimeKeyTable:
                 arrays.append(pa.array([None if v is None else str(v) for v in col], type=pa.string()))
             else:
                 arrays.append(pa.array(col))
-        pq.write_table(pa.table(arrays, names=names), path)
+        with _PARQUET_IO_LOCK:
+            pq.write_table(pa.table(arrays, names=names), path)
         ts = merged.timestamps
         meta = {
             "file": os.path.basename(path),
@@ -155,7 +163,8 @@ class ExpiringTimeKeyTable:
                 continue
             if "min_key" in meta and (meta["min_key"] > hi or meta["max_key"] < lo):
                 continue
-            table = pq.read_table(path)
+            with _PARQUET_IO_LOCK:
+                table = pq.read_table(path, use_threads=False)
             cols: dict[str, np.ndarray] = {}
             for name in table.column_names:
                 arr = table.column(name)

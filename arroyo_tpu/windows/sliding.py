@@ -1,0 +1,244 @@
+"""Sliding (hop) window aggregate operator.
+
+Reference behavior: crates/arroyo-worker/src/arrow/
+sliding_aggregating_window.rs:45 — bin incoming rows by the *slide*; keep
+per-bin partial aggregates; at each slide boundary the watermark passes,
+combine the partials of the ``width/slide`` bins in [end-width, end) and emit
+one row per key, stamping the window start as the output timestamp (:194,
+:217-225); partials are retained until the last window containing them closes
+(:161-162 flush/expire at ``bin_end - width + slide``).
+
+TPU-native redesign: the per-bin partials live in HBM inside the same
+DeviceHashAggregator the tumbling operator uses (bin = slide index); the
+window-close combine is a non-destructive device range-scan of the
+contributing bins (position-chunked so ranges larger than the emit buffer are
+never truncated) followed by a vectorized host combine-by-key — the scanned
+data is already reduced to distinct (bin, key) pairs, so it is tiny relative
+to the event stream the device reduced.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch
+from ..config import config
+from ..engine.engine import register_operator
+from ..expr import eval_expr
+from ..graph import OpName
+from ..operators.base import Operator, TableSpec
+from .tumbling import WINDOW_END, WINDOW_START, KeyDictionary, acc_plan
+
+
+class SlidingAggregate(Operator):
+    """config: width_micros, slide_micros, key_fields: list[str], aggregates:
+    [(name, kind, Expr|None)], final_projection: [(name, Expr)]|None,
+    input_dtype_of, backend override."""
+
+    def __init__(self, cfg: dict):
+        self.width = int(cfg["width_micros"])
+        self.slide = int(cfg["slide_micros"])
+        if self.width % self.slide != 0 or self.width <= 0 or self.slide <= 0:
+            raise ValueError(
+                f"hop window width ({self.width}us) must be a positive multiple "
+                f"of the slide ({self.slide}us)"
+            )
+        self.nb = self.width // self.slide  # bins per window
+        self.key_fields: list[str] = list(cfg.get("key_fields", ()))
+        self.aggregates = cfg["aggregates"]
+        self.final_projection = cfg.get("final_projection")
+        dtype_of = cfg.get("input_dtype_of") or (lambda e: np.dtype(np.float64))
+        self.acc_kinds, self.acc_dtypes, self.acc_inputs = acc_plan(self.aggregates, dtype_of)
+        self.backend = cfg.get("backend") or (
+            "jax" if config().get("device.enabled") else "numpy"
+        )
+        self._agg = None
+        self.key_dict = KeyDictionary(self.key_fields)
+        self.base_bin: Optional[int] = None  # abs slide-bin offset
+        self.min_bin: Optional[int] = None  # earliest live rel bin
+        self.max_bin: Optional[int] = None  # latest rel bin seen
+        self.next_window: Optional[int] = None  # rel start-bin of next window to emit
+        self.late_rows = 0
+
+    # ------------------------------------------------------------------
+
+    def tables(self):
+        # a bin's partials live until the last window containing it closes
+        return [TableSpec("t", "expiring_time_key", retention_micros=self.width)]
+
+    def _aggregator(self):
+        if self._agg is None:
+            from ..ops.aggregate import DeviceHashAggregator
+
+            dev = config().section("device")
+            self._agg = DeviceHashAggregator(
+                self.acc_kinds,
+                self.acc_dtypes,
+                cap=dev.get("table-capacity", 65536),
+                batch_cap=dev.get("batch-capacity", 8192),
+                max_probes=dev.get("max-probes", 64),
+                emit_cap=dev.get("emit-capacity", 8192),
+                backend=self.backend,
+            )
+        return self._agg
+
+    def on_start(self, ctx):
+        tbl = ctx.table_manager.expiring_time_key("t", self.width)
+        batches = tbl.all_batches()
+        if batches:
+            self._restore_from_batch(Batch.concat(batches))
+            tbl.replace_all([])
+
+    def _restore_from_batch(self, b: Batch) -> None:
+        hashes = b.keys.astype(np.uint64)
+        bins_abs = b.timestamps // self.slide
+        self.base_bin = int(bins_abs.min())
+        rel = (bins_abs - self.base_bin).astype(np.int32)
+        accs = [b[f"__acc_{i}"].astype(d) for i, d in enumerate(self.acc_dtypes)]
+        self._aggregator().restore(hashes, rel, accs)
+        self.min_bin = int(rel.min())
+        self.max_bin = int(rel.max())
+        if "__next_window" in b:
+            # stored absolute; aligned barriers mean all prior subtasks saw the
+            # same watermark, so max is a safe merge across rescaled inputs
+            self.next_window = int(b["__next_window"].max()) - self.base_bin
+        else:
+            self.next_window = self.min_bin - self.nb + 1
+        if self.key_fields:
+            self.key_dict.observe(hashes, rel, b)
+
+    # ------------------------------------------------------------------
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        ts = batch.timestamps
+        bins_abs = ts // self.slide
+        if self.base_bin is None:
+            self.base_bin = int(bins_abs.min())
+        rel = (bins_abs - self.base_bin).astype(np.int64)
+        if self.next_window is not None:
+            # a row whose own bin's last window already fired is late
+            late = rel < self.next_window
+            if late.any():
+                self.late_rows += int(late.sum())
+                if late.all():
+                    return
+                batch = batch.filter(~late)
+                rel = rel[~late]
+        rel = rel.astype(np.int32)
+        n = batch.num_rows
+        if KEY_FIELD in batch:
+            hashes = batch.keys.astype(np.uint64)
+        else:
+            hashes = np.zeros(n, dtype=np.uint64)
+        self.key_dict.observe(hashes, rel, batch)
+        vals = []
+        for inp, dt in zip(self.acc_inputs, self.acc_dtypes):
+            if inp is None:
+                vals.append(np.ones(n, dtype=dt))
+            else:
+                vals.append(np.asarray(eval_expr(inp, batch.columns, n)).astype(dt))
+        self._aggregator().update(hashes, rel, vals)
+        lo, hi = int(rel.min()), int(rel.max())
+        self.min_bin = lo if self.min_bin is None else min(self.min_bin, lo)
+        self.max_bin = hi if self.max_bin is None else max(self.max_bin, hi)
+        if self.next_window is None:
+            self.next_window = self.min_bin - self.nb + 1
+
+    def handle_watermark(self, watermark, ctx, collector):
+        if watermark.is_idle:
+            return watermark
+        if self.base_bin is None:
+            return watermark
+        # window starting at rel bin B closes when wm >= (base+B)*slide + width
+        last_closed = (watermark.value - self.width) // self.slide - self.base_bin
+        self._emit_through(int(last_closed), collector)
+        return watermark
+
+    def on_close(self, ctx, collector):
+        if self.max_bin is not None:
+            self._emit_through(self.max_bin, collector)
+
+    def _emit_through(self, last_start_rel: int, collector) -> None:
+        """Emit every unfired window whose start bin is <= last_start_rel."""
+        if self.next_window is None:
+            return
+        agg = self._aggregator()
+        while self.next_window <= last_start_rel:
+            b = self.next_window
+            if self.max_bin is not None and b > self.max_bin:
+                # nothing at or after this window's start; fast-forward
+                self.next_window = last_start_rel + 1
+                break
+            if self.min_bin is not None and b + self.nb <= self.min_bin:
+                # gap: window lies entirely before the earliest live bin
+                nw = min(last_start_rel + 1, self.min_bin - self.nb + 1)
+                self.next_window = max(nw, b + 1)
+                agg.free_bins_below(self.next_window)
+                self.key_dict.evict_closed(self.next_window)
+                continue
+            keys, _bins, accs = agg.scan_range(b, b + self.nb)
+            if len(keys) == 0:
+                # bins < b are freed, so an empty scan proves every live bin
+                # is >= b + nb: re-arm the gap fast-forward above
+                self.min_bin = b + self.nb
+            if len(keys):
+                from ..ops.aggregate import combine_by_key
+
+                keys_c, accs_c = combine_by_key(self.acc_kinds, keys, accs)
+                self._emit_window(b, keys_c, accs_c, collector)
+            self.next_window = b + 1
+            # bins below the next window's range are done
+            agg.free_bins_below(self.next_window)
+            self.key_dict.evict_closed(self.next_window)
+            if self.min_bin is not None:
+                self.min_bin = max(self.min_bin, self.next_window)
+
+    def _emit_window(self, start_rel: int, keys, accs, collector) -> None:
+        from ..ops.aggregate import finalize_aggs
+
+        start = (start_rel + self.base_bin) * self.slide
+        n = len(keys)
+        cols: dict[str, np.ndarray] = {}
+        cols.update(self.key_dict.lookup_columns(keys))
+        cols[WINDOW_START] = np.full(n, start, dtype=np.int64)
+        cols[WINDOW_END] = np.full(n, start + self.width, dtype=np.int64)
+        finals = finalize_aggs([a[1] for a in self.aggregates], accs)
+        for (name, _k, _e), arr in zip(self.aggregates, finals):
+            cols[name] = arr
+        # reference stamps the window start as the output event time (:217)
+        cols[TIMESTAMP_FIELD] = np.full(n, start, dtype=np.int64)
+        out = Batch(cols)
+        if self.final_projection is not None:
+            proj = {name: eval_expr(e, out.columns, n) for name, e in self.final_projection}
+            if TIMESTAMP_FIELD not in proj:
+                proj[TIMESTAMP_FIELD] = out.timestamps
+            out = Batch(proj)
+        collector.collect(out)
+
+    # ------------------------------------------------------------------
+
+    def handle_checkpoint(self, barrier, ctx, collector):
+        keys, bins, accs = self._aggregator().snapshot()
+        tbl = ctx.table_manager.expiring_time_key("t", self.width)
+        if len(keys) == 0:
+            tbl.replace_all([])
+            return
+        starts = (bins.astype(np.int64) + (self.base_bin or 0)) * self.slide
+        cols: dict[str, np.ndarray] = {
+            TIMESTAMP_FIELD: starts,
+            KEY_FIELD: keys,
+            "__next_window": np.full(
+                len(keys), (self.next_window or 0) + (self.base_bin or 0), dtype=np.int64
+            ),
+        }
+        cols.update(self.key_dict.lookup_columns(keys))
+        for i, a in enumerate(accs):
+            cols[f"__acc_{i}"] = a
+        tbl.replace_all([Batch(cols)])
+
+
+@register_operator(OpName.SLIDING_AGGREGATE)
+def _make_sliding(cfg: dict):
+    return SlidingAggregate(cfg)

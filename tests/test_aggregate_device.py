@@ -89,3 +89,22 @@ def test_null_string_keys_hash():
     col = np.array(["a", None, "b", None, "a"], dtype=object)
     h = hash_column(col)
     assert h[0] == h[4] and h[1] == h[3] and h[0] != h[1] != h[2]
+
+
+def test_scan_range_nondivisible_emit_cap():
+    """emit_cap not dividing cap must not duplicate the last slot (gather
+    indices past cap clamp to cap-1 under jit)."""
+    agg = DeviceHashAggregator(("count",), (np.int64,), cap=64, batch_cap=64,
+                               max_probes=64, emit_cap=48, backend="jax")
+    keys = np.arange(40, dtype=np.uint64)
+    agg.update(keys, np.zeros(40, dtype=np.int32), [np.ones(40, dtype=np.int64)])
+    k, b, a = agg.scan_range(0, 1)
+    assert len(k) == 40
+    assert sorted(np.asarray(k).tolist()) == list(range(40))
+    assert a[0].sum() == 40
+    # non-destructive: second scan sees the same entries
+    k2, _, _ = agg.scan_range(0, 1)
+    assert len(k2) == 40
+    agg.free_bins_below(1)
+    k3, _, _ = agg.scan_range(0, 1)
+    assert len(k3) == 0

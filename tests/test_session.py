@@ -1,0 +1,181 @@
+"""Session window aggregate: gap merges, per-key isolation, watermark-driven
+emission, checkpoint/restore."""
+
+import numpy as np
+import pytest
+
+from arroyo_tpu.batch import Batch, TIMESTAMP_FIELD, Schema
+from arroyo_tpu.engine import Engine, run_graph
+from arroyo_tpu.expr import Col
+from arroyo_tpu.graph import EdgeType, Graph, Node, OpName
+from arroyo_tpu.operators.base import OperatorContext
+from arroyo_tpu.state.tables import TableManager
+from arroyo_tpu.types import TaskInfo, Watermark
+from arroyo_tpu.windows.session import SessionAggregate
+
+DUMMY = Schema.of([("x", "int64"), (TIMESTAMP_FIELD, "int64")])
+
+
+class FakeCollector:
+    def __init__(self):
+        self.batches = []
+
+    def collect(self, b):
+        self.batches.append(b)
+
+    def broadcast(self, s):
+        pass
+
+
+def make_op(gap=1000, key_fields=("u",), aggs=None):
+    op = SessionAggregate({
+        "gap_micros": gap,
+        "key_fields": list(key_fields),
+        "aggregates": aggs or [("cnt", "count", None), ("total", "sum", Col("v"))],
+        "input_dtype_of": lambda e: np.dtype(np.int64),
+    })
+    ti = TaskInfo("j", "sess", "session_aggregate", 0, 1)
+    ctx = OperatorContext(ti, None, TableManager(ti, "/tmp/unused-session"))
+    return op, ctx, FakeCollector()
+
+
+def keyed_batch(ts, users, vals):
+    from arroyo_tpu.hashing import hash_columns
+
+    u = np.array(users, dtype=object)
+    return Batch({
+        TIMESTAMP_FIELD: np.array(ts, dtype=np.int64),
+        "u": u,
+        "v": np.array(vals, dtype=np.int64),
+        "_key": hash_columns([u]),
+    })
+
+
+def rows_of(col):
+    out = []
+    for b in col.batches:
+        out.extend(b.to_pylist())
+    return out
+
+
+def test_basic_session_merge_and_emit():
+    op, ctx, col = make_op(gap=1000)
+    # user a: events at 0,500,900 (one session); 3000 (second session)
+    # user b: 100 only
+    op.process_batch(keyed_batch([0, 500, 900, 3000, 100],
+                                 ["a", "a", "a", "a", "b"],
+                                 [1, 2, 3, 4, 10]), ctx, col)
+    # watermark 1500: no session closed yet (a's first session ends 900+1000=1900,
+    # b's ends 100+1000=1100 -> b closes at wm>=1100)
+    op.handle_watermark(Watermark.event_time(1100), ctx, col)
+    rows = rows_of(col)
+    assert len(rows) == 1
+    assert rows[0]["u"] == "b" and rows[0]["cnt"] == 1 and rows[0]["total"] == 10
+    assert rows[0]["window_start"] == 100 and rows[0]["window_end"] == 1100
+    op.handle_watermark(Watermark.event_time(1900), ctx, col)
+    rows = rows_of(col)
+    assert len(rows) == 2
+    a1 = rows[1]
+    assert a1["u"] == "a" and a1["cnt"] == 3 and a1["total"] == 6
+    assert a1["window_start"] == 0 and a1["window_end"] == 1900
+    op.on_close(ctx, col)
+    rows = rows_of(col)
+    assert len(rows) == 3
+    assert rows[2]["u"] == "a" and rows[2]["cnt"] == 1 and rows[2]["total"] == 4
+    assert rows[2]["window_start"] == 3000
+
+
+def test_out_of_order_merges_sessions():
+    """An event landing in the gap between two sessions merges them."""
+    op, ctx, col = make_op(gap=1000)
+    op.process_batch(keyed_batch([0, 2500], ["a", "a"], [1, 2]), ctx, col)
+    # two separate sessions so far; 1200 bridges both (0..1000, 1200 in gap
+    # of first? 1200 - 0 <= ... session1 max=0, 1200-0>1000 -> no; but
+    # 2500-1200>1000 -> no). Use 900 and 1800 to chain-merge everything.
+    op.process_batch(keyed_batch([900, 1800], ["a", "a"], [10, 20]), ctx, col)
+    op.on_close(ctx, col)
+    rows = rows_of(col)
+    assert len(rows) == 1
+    assert rows[0]["cnt"] == 4 and rows[0]["total"] == 33
+    assert rows[0]["window_start"] == 0 and rows[0]["window_end"] == 3500
+
+
+def test_single_batch_run_splitting():
+    """Rows of one batch further apart than the gap split into sessions."""
+    op, ctx, col = make_op(gap=100)
+    op.process_batch(keyed_batch([0, 50, 400, 450, 1000],
+                                 ["a"] * 5, [1, 1, 1, 1, 1]), ctx, col)
+    op.on_close(ctx, col)
+    rows = rows_of(col)
+    assert [(r["window_start"], r["cnt"]) for r in rows] == [(0, 2), (400, 2), (1000, 1)]
+
+
+def test_min_max_avg_aggregates():
+    op, ctx, col = make_op(gap=1000, aggs=[
+        ("mn", "min", Col("v")), ("mx", "max", Col("v")), ("av", "avg", Col("v")),
+    ])
+    op.process_batch(keyed_batch([0, 100, 200], ["a"] * 3, [5, 1, 9]), ctx, col)
+    op.on_close(ctx, col)
+    r = rows_of(col)[0]
+    assert r["mn"] == 1 and r["mx"] == 9 and r["av"] == 5.0
+
+
+def test_session_checkpoint_restore():
+    """Snapshot open sessions, restore into a fresh operator, results match."""
+    storage = "/tmp/session-ckpt-test"
+    import shutil
+
+    shutil.rmtree(storage, ignore_errors=True)
+    ti = TaskInfo("j", "sess", "session_aggregate", 0, 1)
+    cfg = {
+        "gap_micros": 1000,
+        "key_fields": ["u"],
+        "aggregates": [("cnt", "count", None), ("total", "sum", Col("v"))],
+        "input_dtype_of": lambda e: np.dtype(np.int64),
+    }
+    op = SessionAggregate(cfg)
+    tm = TableManager(ti, storage)
+    ctx = OperatorContext(ti, None, tm)
+    col = FakeCollector()
+    op.process_batch(keyed_batch([0, 500, 3000], ["a", "a", "b"], [1, 2, 3]), ctx, col)
+    op.handle_checkpoint(None, ctx, col)
+    tm.checkpoint(1, None)
+
+    op2 = SessionAggregate(cfg)
+    tm2 = TableManager(ti, storage)
+    tm2.restore(1, op2.tables())
+    ctx2 = OperatorContext(ti, None, tm2)
+    col2 = FakeCollector()
+    op2.on_start(ctx2)
+    op2.process_batch(keyed_batch([900], ["a"], [10]), ctx2, col2)
+    op2.on_close(ctx2, col2)
+    rows = sorted(rows_of(col2), key=lambda r: r["u"])
+    assert rows[0]["u"] == "a" and rows[0]["cnt"] == 3 and rows[0]["total"] == 13
+    assert rows[0]["window_start"] == 0 and rows[0]["window_end"] == 1900
+    assert rows[1]["u"] == "b" and rows[1]["cnt"] == 1 and rows[1]["total"] == 3
+
+
+def test_session_end_to_end_graph():
+    """Pipeline run: impulse with bursty timing via projection is complex, so
+    use vec-source style via single-key sessions over impulse gaps."""
+    rows: list = []
+    g = Graph()
+    # impulse: 100 events, 1ms apart -> with gap 10ms all merge to 1 session
+    g.add_node(Node("src", OpName.SOURCE, {
+        "connector": "impulse", "message_count": 100,
+        "interval_micros": 1000, "start_time_micros": 0}, 1))
+    g.add_node(Node("wm", OpName.WATERMARK, {"expr": Col(TIMESTAMP_FIELD)}, 1))
+    g.add_node(Node("agg", OpName.SESSION_AGGREGATE, {
+        "gap_micros": 10_000,
+        "key_fields": [],
+        "aggregates": [("cnt", "count", None)],
+    }, 1))
+    g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": rows}, 1))
+    g.add_edge("src", "wm", EdgeType.FORWARD, DUMMY)
+    g.add_edge("wm", "agg", EdgeType.FORWARD, DUMMY)
+    g.add_edge("agg", "sink", EdgeType.FORWARD, DUMMY)
+    run_graph(g, job_id="sess-e2e", timeout=60)
+    assert len(rows) == 1
+    assert rows[0]["cnt"] == 100
+    assert rows[0]["window_start"] == 0
+    assert rows[0]["window_end"] == 99_000 + 10_000
