@@ -14,15 +14,17 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass
-from typing import Sequence
+from typing import TYPE_CHECKING, Sequence
 
 import numpy as np
 
 from ..batch import KEY_FIELD, Batch
-from ..engine.queues import TaskInbox
 from ..graph import EdgeType
 from ..hashing import servers_for_hashes
 from ..types import Signal
+
+if TYPE_CHECKING:
+    from ..engine.queues import TaskInbox
 
 
 @dataclass

@@ -1,0 +1,475 @@
+"""Join operators.
+
+- InstantJoin: windowed stream-stream join (reference:
+  crates/arroyo-worker/src/arrow/instant_join.rs:38). Upstream window
+  aggregates stamp each row with its window start, so both inputs arrive
+  bucketed by exact timestamp; rows buffer per timestamp and the join for
+  bucket t executes when the merged watermark passes t. Vectorized hash join
+  on the routing-key column (both sides are keyed on the equi-join columns,
+  so equal keys share a hash; hashes are 64-bit and collision-checked by the
+  planner's key columns being carried through).
+- JoinWithExpiration: updating non-windowed join (reference:
+  join_with_expiration.rs:29) — symmetric hash join over TTL'd key-time
+  buffers, emitting retract/append pairs so outer joins stay consistent as
+  matches appear and disappear.
+- LookupJoin: stream enriched against an external keyed table through a
+  lookup connector with a TTL'd cache (reference: lookup_join.rs:35).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Optional
+
+import numpy as np
+
+from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch
+from ..engine.engine import register_operator
+from ..expr import eval_expr
+from ..graph import OpName
+from ..operators.base import Operator, TableSpec
+from .updating_aggregate import IS_RETRACT_FIELD
+
+
+def _object_col(values: list) -> np.ndarray:
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+def _hash_join_indices(
+    left_keys: np.ndarray, right_keys: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Inner-join row index pairs (li, ri) where keys match, vectorized:
+    sort the right side once, binary-search each left key, expand ranges."""
+    order = np.argsort(right_keys, kind="stable")
+    rk = right_keys[order]
+    lo = np.searchsorted(rk, left_keys, side="left")
+    hi = np.searchsorted(rk, left_keys, side="right")
+    counts = hi - lo
+    li = np.repeat(np.arange(len(left_keys)), counts)
+    # for each left row, offsets lo[l]..hi[l] into the sorted right
+    if len(li):
+        within = np.arange(len(li)) - np.repeat(
+            np.cumsum(counts) - counts, counts
+        )
+        ri = order[np.repeat(lo, counts) + within]
+    else:
+        ri = np.empty(0, dtype=np.int64)
+    return li, ri
+
+
+class InstantJoin(Operator):
+    """config: join_type: inner|left|right|full, left_names/right_names:
+    [(out_name, src_name)] column selections per side."""
+
+    def __init__(self, cfg: dict):
+        self.join_type: str = cfg.get("join_type", "inner")
+        self.left_names: list[tuple[str, str]] = list(cfg["left_names"])
+        self.right_names: list[tuple[str, str]] = list(cfg["right_names"])
+        # t -> [left batches], [right batches]
+        self.buf: dict[int, tuple[list, list]] = {}
+        self.late_rows = 0
+        self.emitted_before: Optional[int] = None
+
+    def tables(self):
+        return [
+            TableSpec("left", "expiring_time_key"),
+            TableSpec("right", "expiring_time_key"),
+            TableSpec("e", "global_keyed"),  # late-data barrier
+        ]
+
+    def on_start(self, ctx):
+        for side, name in ((0, "left"), (1, "right")):
+            tbl = ctx.table_manager.expiring_time_key(name)
+            for b in tbl.all_batches():
+                self._buffer(b, side)
+            tbl.replace_all([])
+        barriers = [
+            v for _k, v in ctx.table_manager.global_keyed("e").items() if v is not None
+        ]
+        if barriers:
+            self.emitted_before = max(barriers)
+
+    def _buffer(self, batch: Batch, side: int) -> None:
+        ts = batch.timestamps
+        uniq = np.unique(ts)
+        for t in uniq.tolist():
+            ent = self.buf.setdefault(int(t), ([], []))
+            if len(uniq) == 1:
+                ent[side].append(batch)
+            else:
+                ent[side].append(batch.filter(ts == t))
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        side = ctx.edge_of_input(input_index)
+        if self.emitted_before is not None:
+            late = batch.timestamps < self.emitted_before
+            if late.any():
+                self.late_rows += int(late.sum())
+                if late.all():
+                    return
+                batch = batch.filter(~late)
+        self._buffer(batch, side)
+
+    def handle_watermark(self, watermark, ctx, collector):
+        if not watermark.is_idle:
+            self._emit_closed(watermark.value, collector)
+        return watermark
+
+    def on_close(self, ctx, collector):
+        self._emit_closed(None, collector)
+
+    def _emit_closed(self, before: Optional[int], collector) -> None:
+        ts_list = sorted(
+            t for t in self.buf if before is None or t < before
+        )
+        for t in ts_list:
+            left, right = self.buf.pop(t)
+            self._join_and_emit(t, left, right, collector)
+        if before is not None and (
+            self.emitted_before is None or before > self.emitted_before
+        ):
+            self.emitted_before = before
+
+    def _join_and_emit(self, t: int, left: list, right: list, collector) -> None:
+        lb = Batch.concat(left) if left else None
+        rb = Batch.concat(right) if right else None
+        jt = self.join_type
+        if lb is None and rb is None:
+            return
+        if lb is None:
+            if jt in ("right", "full"):
+                self._emit(t, None, rb, None, None, collector)
+            return
+        if rb is None:
+            if jt in ("left", "full"):
+                self._emit(t, lb, None, None, None, collector)
+            return
+        lk = lb.keys.astype(np.uint64).view(np.int64)
+        rk = rb.keys.astype(np.uint64).view(np.int64)
+        li, ri = _hash_join_indices(lk, rk)
+        out = []
+        if len(li):
+            out.append((lb.take(li), rb.take(ri)))
+        if jt in ("left", "full"):
+            unmatched = np.ones(len(lk), dtype=bool)
+            unmatched[li] = False
+            if unmatched.any():
+                out.append((lb.filter(unmatched), None))
+        if jt in ("right", "full"):
+            unmatched = np.ones(len(rk), dtype=bool)
+            unmatched[ri] = False
+            if unmatched.any():
+                out.append((None, rb.filter(unmatched)))
+        for lpart, rpart in out:
+            self._emit(t, lpart, rpart, None, None, collector)
+
+    def _emit(self, t, lb, rb, _l, _r, collector) -> None:
+        n = lb.num_rows if lb is not None else rb.num_rows
+        cols: dict[str, np.ndarray] = {}
+        for out_name, src in self.left_names:
+            if lb is not None:
+                cols[out_name] = lb[src]
+            else:
+                cols[out_name] = _object_col([None] * n)
+        for out_name, src in self.right_names:
+            if rb is not None:
+                cols[out_name] = rb[src]
+            else:
+                cols[out_name] = _object_col([None] * n)
+        cols[TIMESTAMP_FIELD] = np.full(n, t, dtype=np.int64)
+        src_keys = lb if lb is not None else rb
+        if KEY_FIELD in src_keys:
+            cols[KEY_FIELD] = src_keys.keys
+        collector.collect(Batch(cols))
+
+    def handle_checkpoint(self, barrier, ctx, collector):
+        for side, name in ((0, "left"), (1, "right")):
+            tbl = ctx.table_manager.expiring_time_key(name)
+            batches = []
+            for t, ent in self.buf.items():
+                batches.extend(ent[side])
+            tbl.replace_all(batches)
+        ctx.table_manager.global_keyed("e").insert(
+            ctx.task_info.subtask_index, self.emitted_before
+        )
+
+
+class _StoredRow:
+    __slots__ = ("values", "ts", "key", "match_count", "null_emitted")
+
+    def __init__(self, values: tuple, ts: int, key: int):
+        self.values = values
+        self.ts = ts
+        self.key = key
+        self.match_count = 0
+        self.null_emitted = False
+
+
+class JoinWithExpiration(Operator):
+    """Updating symmetric hash join (reference join_with_expiration.rs:29).
+
+    config: join_type, left_names/right_names: [(out_name, src_name)],
+    ttl_micros (buffer retention, default 1 day). Outputs an updating stream
+    (_is_retract column); outer sides emit (row, nulls) immediately and
+    retract it when a first match arrives.
+    """
+
+    def __init__(self, cfg: dict):
+        self.join_type: str = cfg.get("join_type", "inner")
+        self.left_names: list[tuple[str, str]] = list(cfg["left_names"])
+        self.right_names: list[tuple[str, str]] = list(cfg["right_names"])
+        self.ttl = int(cfg.get("ttl_micros", 24 * 3600 * 1_000_000))
+        # per side: key-hash -> list[_StoredRow]
+        self.stores: tuple[dict, dict] = ({}, {})
+
+    def tables(self):
+        return [
+            TableSpec("left", "expiring_time_key", retention_micros=self.ttl),
+            TableSpec("right", "expiring_time_key", retention_micros=self.ttl),
+        ]
+
+    def _outer_for(self, side: int) -> bool:
+        """Does `side` emit null-padded rows when unmatched?"""
+        return self.join_type == "full" or self.join_type == (
+            "left" if side == 0 else "right"
+        )
+
+    def _src_names(self, side: int) -> list[tuple[str, str]]:
+        return self.left_names if side == 0 else self.right_names
+
+    # ------------------------------------------------------------------
+
+    def on_start(self, ctx):
+        for side, name in ((0, "left"), (1, "right")):
+            tbl = ctx.table_manager.expiring_time_key(name, self.ttl)
+            store = self.stores[side]
+            for b in tbl.all_batches():
+                keys = b.keys.astype(np.uint64).view(np.int64)
+                srcs = [src for _o, src in self._src_names(side)]
+                mc = b["__match_count"]
+                ne = b["__null_emitted"].astype(bool)
+                for j in range(b.num_rows):
+                    row = _StoredRow(
+                        tuple(b[s][j] for s in srcs), int(b.timestamps[j]), int(keys[j])
+                    )
+                    row.match_count = int(mc[j])
+                    row.null_emitted = bool(ne[j])
+                    store.setdefault(int(keys[j]), []).append(row)
+            tbl.replace_all([])
+
+    # ------------------------------------------------------------------
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        side = ctx.edge_of_input(input_index)
+        other = 1 - side
+        n = batch.num_rows
+        keys = batch.keys.astype(np.uint64).view(np.int64)
+        ts = batch.timestamps
+        retracts = (
+            np.asarray(batch[IS_RETRACT_FIELD], dtype=bool)
+            if IS_RETRACT_FIELD in batch
+            else np.zeros(n, dtype=bool)
+        )
+        srcs = [src for _o, src in self._src_names(side)]
+        src_cols = [np.asarray(batch[s]) for s in srcs]
+        out_rows: list[tuple[tuple, tuple, int, bool]] = []  # (lvals, rvals, ts, retract)
+        my_store = self.stores[side]
+        other_store = self.stores[other]
+        for j in range(n):
+            k = int(keys[j])
+            vals = tuple(c[j] for c in src_cols)
+            t = int(ts[j])
+            matches = other_store.get(k, [])
+            if not retracts[j]:
+                row = _StoredRow(vals, t, k)
+                my_store.setdefault(k, []).append(row)
+                row.match_count = len(matches)
+                for m in matches:
+                    if m.match_count == 0 and m.null_emitted:
+                        # first match for an outer-side row: retract its nulls
+                        out_rows.append(self._pad(other, m.values, max(m.ts, t), True))
+                        m.null_emitted = False
+                    m.match_count += 1
+                    out_rows.append(self._pair(side, vals, m.values, max(m.ts, t), False))
+                if not matches and self._outer_for(side):
+                    out_rows.append(self._pad(side, vals, t, False))
+                    row.null_emitted = True
+            else:
+                # retract: remove the stored row with equal values
+                lst = my_store.get(k, [])
+                found = None
+                for i, r in enumerate(lst):
+                    if r.values == vals:
+                        found = i
+                        break
+                if found is None:
+                    raise RuntimeError(
+                        "retract for a row never seen (updating join ordering violation)"
+                    )
+                row = lst.pop(found)
+                if not lst:
+                    my_store.pop(k, None)
+                if row.null_emitted:
+                    out_rows.append(self._pad(side, vals, t, True))
+                else:
+                    for m in matches:
+                        m.match_count -= 1
+                        out_rows.append(self._pair(side, vals, m.values, max(m.ts, t), True))
+                        if m.match_count == 0 and self._outer_for(other):
+                            out_rows.append(self._pad(other, m.values, max(m.ts, t), False))
+                            m.null_emitted = True
+        if out_rows:
+            self._emit(out_rows, collector)
+
+    def _pair(self, side, vals, other_vals, ts, retract):
+        if side == 0:
+            return (vals, other_vals, ts, retract)
+        return (other_vals, vals, ts, retract)
+
+    def _pad(self, side, vals, ts, retract):
+        if side == 0:
+            return (vals, None, ts, retract)
+        return (None, vals, ts, retract)
+
+    def _emit(self, out_rows, collector) -> None:
+        n = len(out_rows)
+        cols: dict[str, np.ndarray] = {}
+        n_l = len(self.left_names)
+        for i, (out_name, _src) in enumerate(self.left_names):
+            cols[out_name] = _object_col(
+                [lv[i] if lv is not None else None for lv, _r, _t, _x in out_rows]
+            )
+        for i, (out_name, _src) in enumerate(self.right_names):
+            cols[out_name] = _object_col(
+                [rv[i] if rv is not None else None for _l, rv, _t, _x in out_rows]
+            )
+        cols[IS_RETRACT_FIELD] = np.array([r for _l, _r, _t, r in out_rows], dtype=bool)
+        cols[TIMESTAMP_FIELD] = np.array([t for _l, _r, t, _x in out_rows], dtype=np.int64)
+        collector.collect(Batch(cols))
+
+    # ------------------------------------------------------------------
+
+    def handle_watermark(self, watermark, ctx, collector):
+        if watermark.is_idle:
+            return watermark
+        cutoff = watermark.value - self.ttl
+        oldest = None
+        for store in self.stores:
+            dead_keys = []
+            for k, lst in store.items():
+                lst[:] = [r for r in lst if r.ts >= cutoff]
+                if not lst:
+                    dead_keys.append(k)
+                else:
+                    for r in lst:
+                        if oldest is None or r.ts < oldest:
+                            oldest = r.ts
+            for k in dead_keys:
+                del store[k]
+        # future emissions carry ts = max(sides) >= the oldest buffered row;
+        # hold the watermark to that bound so downstream never sees late rows
+        held = watermark.value if oldest is None else min(watermark.value, oldest)
+        from ..types import Watermark
+
+        return Watermark.event_time(held)
+
+    def handle_checkpoint(self, barrier, ctx, collector):
+        for side, name in ((0, "left"), (1, "right")):
+            tbl = ctx.table_manager.expiring_time_key(name, self.ttl)
+            store = self.stores[side]
+            rows = [r for lst in store.values() for r in lst]
+            if not rows:
+                tbl.replace_all([])
+                continue
+            srcs = [src for _o, src in self._src_names(side)]
+            cols: dict[str, np.ndarray] = {
+                TIMESTAMP_FIELD: np.array([r.ts for r in rows], dtype=np.int64),
+                KEY_FIELD: np.array([r.key for r in rows], dtype=np.int64).view(np.uint64),
+                "__match_count": np.array([r.match_count for r in rows], dtype=np.int64),
+                "__null_emitted": np.array([r.null_emitted for r in rows], dtype=bool),
+            }
+            for i, s in enumerate(srcs):
+                cols[s] = _object_col([r.values[i] for r in rows])
+            tbl.replace_all([Batch(cols)])
+
+
+class LookupJoin(Operator):
+    """config: connector (object with lookup(keys)->dict, from the connector
+    registry), key_exprs: [Expr] evaluated on the stream, right_names:
+    [(out_name, field)] columns pulled from the looked-up row, join_type:
+    inner|left, cache_ttl_micros, cache_max_size.
+    Reference: lookup_join.rs:35 (async lookups + TTL'd cache table)."""
+
+    def __init__(self, cfg: dict):
+        self.connector = cfg["connector"]
+        self.key_exprs = list(cfg["key_exprs"])
+        self.right_names: list[tuple[str, str]] = list(cfg["right_names"])
+        self.join_type = cfg.get("join_type", "left")
+        self.cache_ttl = int(cfg.get("cache_ttl_micros", 60_000_000))
+        self.cache_max = int(cfg.get("cache_max_size", 100_000))
+        self.cache: dict = {}  # key -> (row|None, wall_micros)
+
+    def tables(self):
+        return [TableSpec("c", "global_keyed")]
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        n = batch.num_rows
+        key_cols = [
+            np.asarray(eval_expr(e, batch.columns, n)) for e in self.key_exprs
+        ]
+        keys = [
+            tuple(c[i] for c in key_cols) if len(key_cols) > 1 else key_cols[0][i]
+            for i in range(n)
+        ]
+        now = int(_time.time() * 1e6)
+        missing = []
+        for k in set(keys):
+            ent = self.cache.get(k)
+            if ent is None or now - ent[1] > self.cache_ttl:
+                missing.append(k)
+        if missing:
+            fetched = self.connector.lookup(missing)
+            for k in missing:
+                self.cache[k] = (fetched.get(k), now)
+        rows = [self.cache[k][0] for k in keys]
+        if len(self.cache) > self.cache_max:
+            # evict oldest entries — after gathering, so this batch's keys
+            # cannot be evicted before they are read
+            by_age = sorted(self.cache.items(), key=lambda kv: kv[1][1])
+            for k, _ in by_age[: len(self.cache) - self.cache_max]:
+                del self.cache[k]
+        present = np.array([r is not None for r in rows], dtype=bool)
+        if self.join_type == "inner" and not present.all():
+            batch = batch.filter(present)
+            rows = [r for r, p in zip(rows, present) if p]
+            present = present[present]
+            n = batch.num_rows
+            if n == 0:
+                return
+        cols = dict(batch.columns)
+        for out_name, field in self.right_names:
+            vals = [r.get(field) if r is not None else None for r in rows]
+            sample = next((v for v in vals if v is not None), None)
+            if isinstance(sample, (str, type(None))) or not present.all():
+                cols[out_name] = _object_col(vals)
+            else:
+                cols[out_name] = np.array(vals)
+        collector.collect(Batch(cols))
+
+
+@register_operator(OpName.INSTANT_JOIN)
+def _make_instant(cfg: dict):
+    return InstantJoin(cfg)
+
+
+@register_operator(OpName.JOIN_WITH_EXPIRATION)
+def _make_expiring(cfg: dict):
+    return JoinWithExpiration(cfg)
+
+
+@register_operator(OpName.LOOKUP_JOIN)
+def _make_lookup(cfg: dict):
+    return LookupJoin(cfg)

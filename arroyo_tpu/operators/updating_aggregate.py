@@ -1,0 +1,317 @@
+"""Updating (non-windowed) aggregate with retractions and TTL.
+
+Reference behavior: crates/arroyo-worker/src/arrow/incremental_aggregator.rs
+:199 — keyed incremental accumulators (UpdatingCache with TTL + generation);
+on the flush interval emit retract/append pairs for keys whose value changed
+(:638-700, identical-value updates suppressed :649-652); TTL eviction emits
+retractions (:683+). Updating rows are tagged via an ``_updating_meta``
+struct with ``is_retract`` (arroyo-rpc/src/lib.rs:254-267); here the flat
+``_is_retract`` boolean column plays that role end-to-end (formats serialize
+it Debezium-style at sinks).
+
+Input may itself be updating (downstream of an updating join): retractions
+are applied with invertible accumulators (sum/count/avg); min/max over an
+updating input would need per-key re-reduce and is rejected at plan time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch
+from ..engine.engine import register_operator
+from ..expr import eval_expr
+from ..graph import OpName
+from ..operators.base import Operator, TableSpec
+from ..windows.tumbling import acc_plan
+
+IS_RETRACT_FIELD = "_is_retract"
+
+
+class _KeyState:
+    __slots__ = ("accs", "count", "emitted", "last_update")
+
+    def __init__(self, accs: list, count: int, last_update: int):
+        self.accs = accs
+        self.count = count  # live rows backing this key (0 -> delete)
+        self.emitted: Optional[tuple] = None  # last appended output values
+        self.last_update = last_update  # event-time micros for TTL
+
+
+class UpdatingAggregate(Operator):
+    """config: key_fields, aggregates: [(name, kind, Expr|None)],
+    flush_interval_micros (default 1s), ttl_micros (default 1 day),
+    input_dtype_of."""
+
+    def __init__(self, cfg: dict):
+        self.key_fields: list[str] = list(cfg.get("key_fields", ()))
+        self.aggregates = cfg["aggregates"]
+        dtype_of = cfg.get("input_dtype_of") or (lambda e: np.dtype(np.float64))
+        self.acc_kinds, self.acc_dtypes, self.acc_inputs = acc_plan(self.aggregates, dtype_of)
+        self.flush_interval = int(cfg.get("flush_interval_micros", 1_000_000))
+        self.ttl = int(cfg.get("ttl_micros", 24 * 3600 * 1_000_000))
+        self.state: dict[int, _KeyState] = {}
+        self.key_values: dict[int, tuple] = {}
+        self.updated: set[int] = set()
+        self.max_event_time: int = 0
+
+    # ------------------------------------------------------------------
+
+    def tables(self):
+        return [TableSpec("s", "expiring_time_key", retention_micros=self.ttl)]
+
+    def tick_interval_micros(self):
+        return self.flush_interval
+
+    def on_start(self, ctx):
+        tbl = ctx.table_manager.expiring_time_key("s", self.ttl)
+        batches = tbl.all_batches()
+        if batches:
+            b = Batch.concat(batches)
+            hashes = b.keys.astype(np.uint64).view(np.int64)
+            key_cols = [b[f] for f in self.key_fields]
+            emitted_mask = b["__has_emitted"].astype(bool) if "__has_emitted" in b else None
+            n_agg = len(self.aggregates)
+            for j in range(b.num_rows):
+                h = int(hashes[j])
+                accs = [d.type(b[f"__acc_{i}"][j]) for i, d in enumerate(self.acc_dtypes)]
+                st = _KeyState(accs, int(b["__count"][j]), int(b.timestamps[j]))
+                if emitted_mask is not None and emitted_mask[j]:
+                    st.emitted = tuple(
+                        b[f"__emitted_{i}"][j] for i in range(n_agg)
+                    )
+                self.state[h] = st
+                if self.key_fields:
+                    self.key_values[h] = tuple(c[j] for c in key_cols)
+            tbl.replace_all([])
+
+    # ------------------------------------------------------------------
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        n = batch.num_rows
+        ts = batch.timestamps
+        self.max_event_time = max(self.max_event_time, int(ts.max()))
+        if KEY_FIELD in batch:
+            hashes = batch.keys.astype(np.uint64).view(np.int64)
+        else:
+            hashes = np.zeros(n, dtype=np.int64)
+        retracts = (
+            np.asarray(batch[IS_RETRACT_FIELD], dtype=bool)
+            if IS_RETRACT_FIELD in batch
+            else np.zeros(n, dtype=bool)
+        )
+        if retracts.any():
+            for kind in self.acc_kinds:
+                if kind not in ("sum", "count"):
+                    raise ValueError(
+                        f"updating aggregate over an updating input requires "
+                        f"invertible accumulators; {kind} is not"
+                    )
+        # accumulate values per row, then fold per unique key
+        vals = []
+        for inp, dt in zip(self.acc_inputs, self.acc_dtypes):
+            if inp is None:
+                vals.append(np.ones(n, dtype=dt))
+            else:
+                vals.append(np.asarray(eval_expr(inp, batch.columns, n)).astype(dt))
+        order = np.argsort(hashes, kind="stable")
+        k_s = hashes[order]
+        r_s = retracts[order]
+        t_s = np.asarray(ts)[order]
+        v_s = [v[order] for v in vals]
+        brk = np.ones(n, dtype=bool)
+        brk[1:] = k_s[1:] != k_s[:-1]
+        starts = np.flatnonzero(brk)
+        ends = np.append(starts[1:], n)
+        if self.key_fields:
+            cols = [np.asarray(batch[f])[order] for f in self.key_fields]
+            for si in starts:
+                h = int(k_s[si])
+                if h not in self.key_values:
+                    self.key_values[h] = tuple(c[si] for c in cols)
+        for si, ei in zip(starts, ends):
+            h = int(k_s[si])
+            st = self.state.get(h)
+            last_ts = int(t_s[ei - 1])
+            if st is None:
+                st = _KeyState(
+                    [self._identity(i) for i in range(len(self.acc_kinds))], 0, last_ts
+                )
+                self.state[h] = st
+            st.last_update = max(st.last_update, last_ts)
+            seg_r = r_s[si:ei]
+            n_app = int((~seg_r).sum())
+            n_ret = int(seg_r.sum())
+            st.count += n_app - n_ret
+            if st.count < 0:
+                raise RuntimeError(
+                    "retract without matching append for key (updating stream "
+                    "ordering violation)"
+                )
+            for i, kind in enumerate(self.acc_kinds):
+                seg = v_s[i][si:ei]
+                app = seg[~seg_r]
+                ret = seg[seg_r]
+                cur = st.accs[i]
+                if kind in ("sum", "count"):
+                    cur = cur + app.sum() - ret.sum()
+                elif kind == "min":
+                    cur = min(cur, app.min()) if len(app) else cur
+                else:
+                    cur = max(cur, app.max()) if len(app) else cur
+                st.accs[i] = self.acc_dtypes[i].type(cur)
+            self.updated.add(h)
+
+    def _identity(self, i: int):
+        from ..ops.aggregate import _identity
+
+        return _identity(self.acc_kinds[i], self.acc_dtypes[i])
+
+    # ------------------------------------------------------------------
+
+    def _finalize(self, st: _KeyState) -> tuple:
+        from ..ops.aggregate import finalize_aggs
+
+        arrays = [np.array([a]) for a in st.accs]
+        finals = finalize_aggs([a[1] for a in self.aggregates], arrays)
+        return tuple(f[0] for f in finals)
+
+    def _flush(self, collector, evict_before: Optional[int] = None) -> None:
+        """Emit retract/append pairs for keys whose value changed
+        (reference :638-700); TTL-evict idle keys with a retraction."""
+        out_rows: list[tuple[int, tuple, bool]] = []  # (hash, values, is_retract)
+        dead: list[int] = []
+        for h in sorted(self.updated):
+            st = self.state.get(h)
+            if st is None:
+                continue
+            if st.count == 0:
+                if st.emitted is not None:
+                    out_rows.append((h, st.emitted, True))
+                dead.append(h)
+                continue
+            new_vals = self._finalize(st)
+            if st.emitted is not None:
+                if st.emitted == new_vals:
+                    continue  # suppress no-op updates (reference :649-652)
+                out_rows.append((h, st.emitted, True))
+            out_rows.append((h, new_vals, False))
+            st.emitted = new_vals
+        self.updated.clear()
+        if evict_before is not None:
+            for h, st in self.state.items():
+                if st.last_update < evict_before and h not in dead:
+                    if st.emitted is not None:
+                        out_rows.append((h, st.emitted, True))
+                    dead.append(h)
+        if out_rows:
+            self._emit(out_rows, collector)
+        # evict only after emission so retractions can still resolve key values
+        for h in dead:
+            self.state.pop(h, None)
+            self.key_values.pop(h, None)
+
+    def _emit(self, out_rows, collector) -> None:
+        n = len(out_rows)
+        cols: dict[str, np.ndarray] = {}
+        if self.key_fields:
+            for j, f in enumerate(self.key_fields):
+                vals = [
+                    self.key_values.get(h, (None,) * len(self.key_fields))[j]
+                    for h, _v, _r in out_rows
+                ]
+                sample = next((v for v in vals if v is not None), None)
+                if isinstance(sample, (str, type(None))):
+                    cols[f] = np.array(vals, dtype=object)
+                else:
+                    cols[f] = np.array(vals)
+        for i, (name, _k, _e) in enumerate(self.aggregates):
+            vals = [v[i] for _h, v, _r in out_rows]
+            cols[name] = np.array(vals)
+        cols[IS_RETRACT_FIELD] = np.array([r for _h, _v, r in out_rows], dtype=bool)
+        cols[TIMESTAMP_FIELD] = np.full(n, self.max_event_time, dtype=np.int64)
+        collector.collect(Batch(cols))
+
+    # ------------------------------------------------------------------
+
+    def handle_tick(self, ctx, collector):
+        self._flush(collector, evict_before=self.max_event_time - self.ttl)
+
+    def handle_watermark(self, watermark, ctx, collector):
+        if not watermark.is_idle:
+            self._flush(collector, evict_before=watermark.value - self.ttl)
+        return watermark
+
+    def on_close(self, ctx, collector):
+        self._flush(collector)
+
+    def handle_checkpoint(self, barrier, ctx, collector):
+        # flush first so `emitted` mirrors what downstream has seen before the
+        # barrier, then snapshot — otherwise un-flushed updates are lost on
+        # restore because the `updated` set is not persisted
+        self._flush(collector)
+        tbl = ctx.table_manager.expiring_time_key("s", self.ttl)
+        items = sorted(self.state.items())
+        if not items:
+            tbl.replace_all([])
+            return
+        n = len(items)
+        n_agg = len(self.aggregates)
+        cols: dict[str, np.ndarray] = {
+            TIMESTAMP_FIELD: np.array([st.last_update for _h, st in items], dtype=np.int64),
+            KEY_FIELD: np.array([h for h, _st in items], dtype=np.int64).view(np.uint64),
+            "__count": np.array([st.count for _h, st in items], dtype=np.int64),
+            "__has_emitted": np.array([st.emitted is not None for _h, st in items], dtype=bool),
+        }
+        for i, d in enumerate(self.acc_dtypes):
+            cols[f"__acc_{i}"] = np.array([st.accs[i] for _h, st in items], dtype=d)
+        for i in range(n_agg):
+            vals = [
+                st.emitted[i] if st.emitted is not None else 0
+                for _h, st in items
+            ]
+            cols[f"__emitted_{i}"] = np.array(vals)
+        if self.key_fields:
+            for j, f in enumerate(self.key_fields):
+                vals = [
+                    self.key_values.get(h, (None,) * len(self.key_fields))[j]
+                    for h, _st in items
+                ]
+                sample = next((v for v in vals if v is not None), None)
+                if isinstance(sample, (str, type(None))):
+                    cols[f] = np.array(vals, dtype=object)
+                else:
+                    cols[f] = np.array(vals)
+        tbl.replace_all([Batch(cols)])
+
+
+def merge_updating_rows(rows: list[dict]) -> list[dict]:
+    """Materialize an updating stream: apply retract/append pairs in order and
+    return the surviving rows (the reference smoke-test harness does the same
+    to Debezium output before diffing, smoke_tests.rs:475-521)."""
+    from collections import Counter
+
+    live: Counter = Counter()
+    for r in rows:
+        retract = bool(r.get(IS_RETRACT_FIELD, r.get("_is_retract", False)))
+        key = tuple(
+            (k, v)
+            for k, v in sorted(r.items())
+            if k not in (IS_RETRACT_FIELD, TIMESTAMP_FIELD)
+        )
+        if retract:
+            live[key] -= 1
+        else:
+            live[key] += 1
+    out = []
+    for key, cnt in live.items():
+        for _ in range(cnt):
+            out.append(dict(key))
+    return out
+
+
+@register_operator(OpName.UPDATING_AGGREGATE)
+def _make_updating(cfg: dict):
+    return UpdatingAggregate(cfg)

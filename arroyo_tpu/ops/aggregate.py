@@ -65,8 +65,8 @@ def finalize_aggs(kinds: Sequence[str], acc_arrays: list[np.ndarray]) -> list[np
     return out
 
 
-def drain_extract(extract_once, emit_cap: int, acc_dtypes: Sequence[np.dtype],
-                  emit_lo: int, free_below: int):
+def drain_extract(extract_once, emit_cap: int, acc_kinds: Sequence[str],
+                  acc_dtypes: Sequence[np.dtype], emit_lo: int, free_below: int):
     """Host-side drain loop shared by the single-chip and sharded
     aggregators. ``extract_once()`` performs one device extraction and
     returns (key_i64, bin, valid, accs, max_total) as numpy arrays/ints.
@@ -74,7 +74,11 @@ def drain_extract(extract_once, emit_cap: int, acc_dtypes: Sequence[np.dtype],
     Termination invariants: entries in the emit range are freed only when
     below ``free_below``, so a destructive close shrinks each round; a pure
     range scan (free_below <= emit_lo) must bail after one round or it would
-    re-emit the same entries forever."""
+    re-emit the same entries forever.
+
+    The result is merged with combine_by_key_bin: in-place slot freeing
+    punches holes in probe chains, so the table may hold duplicate (key, bin)
+    entries whose accumulators each carry part of the total."""
     keys_out, bins_out = [], []
     accs_out: list[list[np.ndarray]] = [[] for _ in acc_dtypes]
     while True:
@@ -93,11 +97,46 @@ def drain_extract(extract_once, emit_cap: int, acc_dtypes: Sequence[np.dtype],
             np.empty(0, dtype=np.int32),
             [np.empty(0, dtype=d) for d in acc_dtypes],
         )
-    return (
+    return combine_by_key_bin(
+        acc_kinds,
         np.concatenate(keys_out).view(np.uint64),
         np.concatenate(bins_out),
         [np.concatenate(a) for a in accs_out],
     )
+
+
+def combine_by_key_bin(
+    acc_kinds: Sequence[str],
+    keys: np.ndarray,
+    bins: np.ndarray,
+    accs: list[np.ndarray],
+) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
+    """Merge duplicate (key, bin) entries after a device extraction. The
+    linear-probe table frees slots in place when bins close, which punches
+    holes in probe chains: a later update of a live (key, bin) can claim a
+    hole before reaching its original entry, leaving two entries whose
+    accumulators each hold part of the total. Emission must re-combine them."""
+    if len(keys) <= 1:
+        return keys, bins, accs
+    signed = keys.view(np.int64)
+    order = np.lexsort((signed, bins))
+    k_s, b_s = signed[order], bins[order]
+    newseg = np.ones(len(k_s), dtype=bool)
+    newseg[1:] = (k_s[1:] != k_s[:-1]) | (b_s[1:] != b_s[:-1])
+    if newseg.all():
+        return keys, bins, accs
+    starts = np.flatnonzero(newseg)
+    out_accs = []
+    for kind, a in zip(acc_kinds, accs):
+        a_s = a[order]
+        if kind in ("sum", "count"):
+            red = np.add.reduceat(a_s, starts)
+        elif kind == "min":
+            red = np.minimum.reduceat(a_s, starts)
+        else:
+            red = np.maximum.reduceat(a_s, starts)
+        out_accs.append(red.astype(a.dtype))
+    return k_s[starts].view(np.uint64), b_s[starts], out_accs
 
 
 def combine_by_key(
@@ -449,8 +488,8 @@ class DeviceHashAggregator:
                 [np.asarray(a) for a in accs], int(total),
             )
 
-        return drain_extract(extract_once, self.emit_cap, self.acc_dtypes,
-                             emit_lo, free_below)
+        return drain_extract(extract_once, self.emit_cap, self.acc_kinds,
+                             self.acc_dtypes, emit_lo, free_below)
 
     def scan_range(self, emit_lo: int, emit_hi: int) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
         """Non-destructive read of every entry with bin in [emit_lo, emit_hi)
@@ -488,7 +527,8 @@ class DeviceHashAggregator:
                 np.empty(0, dtype=np.int32),
                 [np.empty(0, dtype=d) for d in self.acc_dtypes],
             )
-        return (
+        return combine_by_key_bin(
+            self.acc_kinds,
             np.concatenate(keys_out).view(np.uint64),
             np.concatenate(bins_out),
             [np.concatenate(a) for a in accs_out],
@@ -535,7 +575,8 @@ class DeviceHashAggregator:
         self._check_overflow()
         keys_t, bins_t, occ_t, accs_t, _oflow = self.state
         occ = np.asarray(occ_t)
-        return (
+        return combine_by_key_bin(
+            self.acc_kinds,
             np.asarray(keys_t)[occ].view(np.uint64),
             np.asarray(bins_t)[occ],
             [np.asarray(a)[occ] for a in accs_t],

@@ -263,8 +263,8 @@ class ShardedAggregator:
                 int(np.asarray(total).max()),
             )
 
-        out = drain_extract(extract_once, self.emit_cap, self.acc_dtypes,
-                            emit_lo, free_below)
+        out = drain_extract(extract_once, self.emit_cap, self.acc_kinds,
+                            self.acc_dtypes, emit_lo, free_below)
         overflow = int(np.asarray(self.state[4]).sum())
         if overflow > 0:
             raise RuntimeError(

@@ -27,6 +27,7 @@ from ..engine.engine import register_operator
 from ..expr import eval_expr
 from ..graph import OpName
 from ..operators.base import Operator, TableSpec
+from ..types import Watermark
 from .tumbling import WINDOW_END, WINDOW_START, acc_plan
 
 
@@ -185,10 +186,19 @@ class SessionAggregate(Operator):
     # ------------------------------------------------------------------
 
     def handle_watermark(self, watermark, ctx, collector):
-        if not watermark.is_idle:
-            self._emit_closed(watermark.value, collector)
-            self.emitted_watermark = watermark.value
-        return watermark
+        if watermark.is_idle:
+            return watermark
+        self._emit_closed(watermark.value, collector)
+        self.emitted_watermark = watermark.value
+        # future emissions are stamped window_start = session min_ts: open
+        # sessions may hold arbitrarily old starts, and brand-new sessions
+        # can begin at ts > w - gap; forward the lower bound (see tumbling)
+        held = watermark.value - self.gap
+        for lst in self.sessions.values():
+            for s in lst:
+                if s.min_ts < held:
+                    held = s.min_ts
+        return Watermark.event_time(held)
 
     def on_close(self, ctx, collector):
         self._emit_closed(None, collector)

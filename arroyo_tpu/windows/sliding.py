@@ -29,6 +29,7 @@ from ..engine.engine import register_operator
 from ..expr import eval_expr
 from ..graph import OpName
 from ..operators.base import Operator, TableSpec
+from ..types import Watermark
 from .tumbling import WINDOW_END, WINDOW_START, KeyDictionary, acc_plan
 
 
@@ -149,12 +150,14 @@ class SlidingAggregate(Operator):
     def handle_watermark(self, watermark, ctx, collector):
         if watermark.is_idle:
             return watermark
-        if self.base_bin is None:
-            return watermark
-        # window starting at rel bin B closes when wm >= (base+B)*slide + width
-        last_closed = (watermark.value - self.width) // self.slide - self.base_bin
-        self._emit_through(int(last_closed), collector)
-        return watermark
+        if self.base_bin is not None:
+            # window starting at rel bin B closes when wm >= (base+B)*slide + width
+            last_closed = (watermark.value - self.width) // self.slide - self.base_bin
+            self._emit_through(int(last_closed), collector)
+        # future emissions are stamped with window starts strictly after the
+        # last closed boundary; forward that lower bound (see tumbling)
+        held = ((watermark.value - self.width) // self.slide + 1) * self.slide
+        return Watermark.event_time(min(watermark.value, held))
 
     def on_close(self, ctx, collector):
         if self.max_bin is not None:

@@ -208,7 +208,12 @@ class TumblingAggregate(Operator):
             return watermark
         closed_before_abs = watermark.value // self.width
         self._emit_closed(closed_before_abs, collector)
-        return watermark
+        # Future emissions are stamped with a window start >= bin_start(w);
+        # forward that instead of w so downstream operators (e.g. windowed
+        # joins) never see our output as late. The reference forwards w
+        # unchanged and relies on sparse watermarks; with dense per-batch
+        # watermarks the adjusted value is required for correctness.
+        return Watermark.event_time(closed_before_abs * self.width)
 
     def on_close(self, ctx, collector):
         self._emit_closed(None, collector)

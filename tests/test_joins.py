@@ -1,0 +1,281 @@
+"""Join operators: windowed instant join (inner/left/right/full), updating
+join with retractions, lookup join caching."""
+
+import numpy as np
+import pytest
+
+from arroyo_tpu.batch import Batch, TIMESTAMP_FIELD
+from arroyo_tpu.hashing import hash_columns
+from arroyo_tpu.operators.base import OperatorContext
+from arroyo_tpu.operators.joins import InstantJoin, JoinWithExpiration, LookupJoin
+from arroyo_tpu.operators.updating_aggregate import IS_RETRACT_FIELD, merge_updating_rows
+from arroyo_tpu.state.tables import TableManager
+from arroyo_tpu.types import TaskInfo, Watermark
+
+
+class FakeCollector:
+    def __init__(self):
+        self.batches = []
+
+    def collect(self, b):
+        self.batches.append(b)
+
+    def broadcast(self, s):
+        pass
+
+
+def rows_of(col):
+    out = []
+    for b in col.batches:
+        out.extend(b.to_pylist())
+    return out
+
+
+def two_input_ctx(name="join", storage="/tmp/join-unused"):
+    """Context where input 0 -> edge 0 (left), input 1 -> edge 1 (right)."""
+    ti = TaskInfo("j", name, name, 0, 1)
+    return OperatorContext(
+        ti, None, TableManager(ti, storage), in_edge_of_input=lambda i: (i, 0)
+    )
+
+
+def kb(ts, keys, vals, vname="v", retracts=None):
+    k = np.array(keys, dtype=np.int64)
+    cols = {
+        TIMESTAMP_FIELD: np.array(ts, dtype=np.int64),
+        "id": k,
+        vname: np.array(vals, dtype=object),
+        "_key": hash_columns([k]),
+    }
+    if retracts is not None:
+        cols[IS_RETRACT_FIELD] = np.array(retracts, dtype=bool)
+    return Batch(cols)
+
+
+def make_instant(jt="inner"):
+    op = InstantJoin({
+        "join_type": jt,
+        "left_names": [("lid", "id"), ("lv", "v")],
+        "right_names": [("rid", "id"), ("rv", "v")],
+    })
+    return op, two_input_ctx(), FakeCollector()
+
+
+def test_instant_inner_join():
+    op, ctx, col = make_instant()
+    op.process_batch(kb([100, 100], [1, 2], ["a", "b"]), ctx, col, input_index=0)
+    op.process_batch(kb([100, 100, 100], [2, 2, 3], ["x", "y", "z"]), ctx, col, input_index=1)
+    op.handle_watermark(Watermark.event_time(50), ctx, col)
+    assert rows_of(col) == []  # bucket 100 not closed yet
+    op.handle_watermark(Watermark.event_time(101), ctx, col)
+    rows = sorted(rows_of(col), key=lambda r: (r["lid"], r["rv"]))
+    assert [(r["lid"], r["lv"], r["rid"], r["rv"]) for r in rows] == [
+        (2, "b", 2, "x"), (2, "b", 2, "y"),
+    ]
+
+
+def test_instant_outer_joins():
+    for jt, expected in [
+        ("left", {(1, "a", None, None), (2, "b", 2, "x")}),
+        ("right", {(2, "b", 2, "x"), (None, None, 3, "z")}),
+        ("full", {(1, "a", None, None), (2, "b", 2, "x"), (None, None, 3, "z")}),
+    ]:
+        op, ctx, col = make_instant(jt)
+        op.process_batch(kb([100, 100], [1, 2], ["a", "b"]), ctx, col, input_index=0)
+        op.process_batch(kb([100, 100], [2, 3], ["x", "z"]), ctx, col, input_index=1)
+        op.on_close(ctx, col)
+        got = {(r["lid"], r["lv"], r["rid"], r["rv"]) for r in rows_of(col)}
+        assert got == expected, jt
+
+
+def test_instant_join_buckets_by_timestamp():
+    """Rows in different time buckets never join."""
+    op, ctx, col = make_instant()
+    op.process_batch(kb([100], [1], ["a"]), ctx, col, input_index=0)
+    op.process_batch(kb([200], [1], ["b"]), ctx, col, input_index=1)
+    op.on_close(ctx, col)
+    assert rows_of(col) == []
+
+
+def test_instant_join_checkpoint_restore(tmp_path):
+    storage = str(tmp_path / "ij")
+    cfg = {
+        "join_type": "inner",
+        "left_names": [("lid", "id"), ("lv", "v")],
+        "right_names": [("rid", "id"), ("rv", "v")],
+    }
+    ti = TaskInfo("j", "join", "instant_join", 0, 1)
+    tm = TableManager(ti, storage)
+    ctx = OperatorContext(ti, None, tm, in_edge_of_input=lambda i: (i, 0))
+    op = InstantJoin(cfg)
+    col = FakeCollector()
+    op.process_batch(kb([100], [1], ["a"]), ctx, col, input_index=0)
+    op.handle_checkpoint(None, ctx, col)
+    tm.checkpoint(1, None)
+
+    op2 = InstantJoin(cfg)
+    tm2 = TableManager(ti, storage)
+    tm2.restore(1, op2.tables())
+    ctx2 = OperatorContext(ti, None, tm2, in_edge_of_input=lambda i: (i, 0))
+    col2 = FakeCollector()
+    op2.on_start(ctx2)
+    op2.process_batch(kb([100], [1], ["z"]), ctx2, col2, input_index=1)
+    op2.on_close(ctx2, col2)
+    rows = rows_of(col2)
+    assert len(rows) == 1 and rows[0]["lv"] == "a" and rows[0]["rv"] == "z"
+
+
+# ---------------------------------------------------------------- updating
+
+
+def make_updating(jt="inner"):
+    op = JoinWithExpiration({
+        "join_type": jt,
+        "left_names": [("lid", "id"), ("lv", "v")],
+        "right_names": [("rid", "id"), ("rv", "v")],
+    })
+    return op, two_input_ctx("exp_join"), FakeCollector()
+
+
+def test_updating_inner_join_append_only():
+    op, ctx, col = make_updating()
+    op.process_batch(kb([0], [1], ["a"]), ctx, col, input_index=0)
+    assert rows_of(col) == []  # no match yet
+    op.process_batch(kb([1], [1], ["x"]), ctx, col, input_index=1)
+    rows = rows_of(col)
+    assert len(rows) == 1
+    assert rows[0]["lv"] == "a" and rows[0]["rv"] == "x"
+    assert rows[0][IS_RETRACT_FIELD] is False
+    # second left row joins existing right
+    op.process_batch(kb([2], [1], ["b"]), ctx, col, input_index=0)
+    final = merge_updating_rows(rows_of(col))
+    assert len(final) == 2
+
+
+def test_updating_left_join_null_then_match():
+    op, ctx, col = make_updating("left")
+    op.process_batch(kb([0], [1], ["a"]), ctx, col, input_index=0)
+    rows = rows_of(col)
+    # immediate (left, null) emission
+    assert len(rows) == 1 and rows[0]["rv"] is None and not rows[0][IS_RETRACT_FIELD]
+    op.process_batch(kb([1], [1], ["x"]), ctx, col, input_index=1)
+    rows = rows_of(col)
+    # nulls retracted, matched pair appended
+    assert len(rows) == 3
+    assert rows[1][IS_RETRACT_FIELD] is True and rows[1]["rv"] is None
+    assert rows[2][IS_RETRACT_FIELD] is False and rows[2]["rv"] == "x"
+    final = merge_updating_rows(rows)
+    assert final == [{"lid": 1, "lv": "a", "rid": 1, "rv": "x"}]
+
+
+def test_updating_join_retract_last_match_restores_nulls():
+    op, ctx, col = make_updating("left")
+    op.process_batch(kb([0], [1], ["a"]), ctx, col, input_index=0)
+    op.process_batch(kb([1], [1], ["x"]), ctx, col, input_index=1)
+    # retract the right row: pair retracted, (left, null) re-emitted
+    op.process_batch(kb([2], [1], ["x"], retracts=[True]), ctx, col, input_index=1)
+    final = merge_updating_rows(rows_of(col))
+    assert final == [{"lid": 1, "lv": "a", "rid": None, "rv": None}]
+
+
+def test_updating_full_join():
+    op, ctx, col = make_updating("full")
+    op.process_batch(kb([0], [1], ["a"]), ctx, col, input_index=0)
+    op.process_batch(kb([1], [2], ["x"]), ctx, col, input_index=1)
+    final = sorted(
+        merge_updating_rows(rows_of(col)),
+        key=lambda r: (r["lid"] is None, r["lid"] or 0),
+    )
+    assert final == [
+        {"lid": 1, "lv": "a", "rid": None, "rv": None},
+        {"lid": None, "lv": None, "rid": 2, "rv": "x"},
+    ]
+
+
+def test_updating_join_ttl_expiry():
+    op, ctx, col = make_updating()
+    op.ttl = 1000
+    op.process_batch(kb([0], [1], ["a"]), ctx, col, input_index=0)
+    op.handle_watermark(Watermark.event_time(5000), ctx, col)  # expire left row
+    op.process_batch(kb([5000], [1], ["x"]), ctx, col, input_index=1)
+    assert rows_of(col) == []  # expired row no longer joins
+
+
+def test_updating_join_checkpoint_restore(tmp_path):
+    storage = str(tmp_path / "uj")
+    cfg = {
+        "join_type": "left",
+        "left_names": [("lid", "id"), ("lv", "v")],
+        "right_names": [("rid", "id"), ("rv", "v")],
+    }
+    ti = TaskInfo("j", "exp_join", "join_with_expiration", 0, 1)
+    tm = TableManager(ti, storage)
+    ctx = OperatorContext(ti, None, tm, in_edge_of_input=lambda i: (i, 0))
+    op = JoinWithExpiration(cfg)
+    col = FakeCollector()
+    op.process_batch(kb([0], [1], ["a"]), ctx, col, input_index=0)  # emits (a, null)
+    op.handle_checkpoint(None, ctx, col)
+    tm.checkpoint(1, None)
+
+    op2 = JoinWithExpiration(cfg)
+    tm2 = TableManager(ti, storage)
+    tm2.restore(1, op2.tables())
+    ctx2 = OperatorContext(ti, None, tm2, in_edge_of_input=lambda i: (i, 0))
+    col2 = FakeCollector()
+    op2.on_start(ctx2)
+    op2.process_batch(kb([1], [1], ["x"]), ctx2, col2, input_index=1)
+    rows = rows_of(col2)
+    # null_emitted survived the restore: nulls retracted before the append
+    assert len(rows) == 2
+    assert rows[0][IS_RETRACT_FIELD] is True and rows[0]["rv"] is None
+    assert rows[1][IS_RETRACT_FIELD] is False and rows[1]["rv"] == "x"
+
+
+# ---------------------------------------------------------------- lookup
+
+
+class DictLookup:
+    def __init__(self, table):
+        self.table = table
+        self.calls = 0
+
+    def lookup(self, keys):
+        self.calls += 1
+        return {k: self.table.get(k) for k in keys}
+
+
+def test_lookup_join_left_and_cache():
+    conn = DictLookup({1: {"name": "one"}, 2: {"name": "two"}})
+    from arroyo_tpu.expr import Col
+
+    op = LookupJoin({
+        "connector": conn,
+        "key_exprs": [Col("id")],
+        "right_names": [("name", "name")],
+        "join_type": "left",
+    })
+    ctx = two_input_ctx("lookup")
+    col = FakeCollector()
+    op.process_batch(kb([0, 1, 2], [1, 2, 9], ["a", "b", "c"]), ctx, col)
+    rows = rows_of(col)
+    assert [r["name"] for r in rows] == ["one", "two", None]
+    assert conn.calls == 1
+    op.process_batch(kb([3], [1], ["d"]), ctx, col)
+    assert conn.calls == 1  # cache hit
+
+
+def test_lookup_join_inner_filters_missing():
+    conn = DictLookup({1: {"name": "one"}})
+    from arroyo_tpu.expr import Col
+
+    op = LookupJoin({
+        "connector": conn,
+        "key_exprs": [Col("id")],
+        "right_names": [("name", "name")],
+        "join_type": "inner",
+    })
+    ctx = two_input_ctx("lookup")
+    col = FakeCollector()
+    op.process_batch(kb([0, 1], [1, 9], ["a", "b"]), ctx, col)
+    rows = rows_of(col)
+    assert len(rows) == 1 and rows[0]["v"] == "a" and rows[0]["name"] == "one"

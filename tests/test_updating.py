@@ -1,0 +1,158 @@
+"""Updating aggregate: retract/append emission, no-op suppression, TTL
+eviction, updating input retractions, checkpoint/restore."""
+
+import numpy as np
+import pytest
+
+from arroyo_tpu.batch import Batch, TIMESTAMP_FIELD
+from arroyo_tpu.expr import Col
+from arroyo_tpu.hashing import hash_columns
+from arroyo_tpu.operators.base import OperatorContext
+from arroyo_tpu.operators.updating_aggregate import (
+    IS_RETRACT_FIELD,
+    UpdatingAggregate,
+    merge_updating_rows,
+)
+from arroyo_tpu.state.tables import TableManager
+from arroyo_tpu.types import TaskInfo, Watermark
+
+
+class FakeCollector:
+    def __init__(self):
+        self.batches = []
+
+    def collect(self, b):
+        self.batches.append(b)
+
+    def broadcast(self, s):
+        pass
+
+
+def rows_of(col):
+    out = []
+    for b in col.batches:
+        out.extend(b.to_pylist())
+    return out
+
+
+def make_op(aggs=None, ttl=None, storage="/tmp/upd-agg-unused"):
+    cfg = {
+        "key_fields": ["u"],
+        "aggregates": aggs or [("cnt", "count", None), ("total", "sum", Col("v"))],
+        "input_dtype_of": lambda e: np.dtype(np.int64),
+    }
+    if ttl:
+        cfg["ttl_micros"] = ttl
+    op = UpdatingAggregate(cfg)
+    ti = TaskInfo("j", "upd", "updating_aggregate", 0, 1)
+    ctx = OperatorContext(ti, None, TableManager(ti, storage))
+    return op, cfg, ctx, FakeCollector()
+
+
+def keyed_batch(ts, users, vals, retracts=None):
+    u = np.array(users, dtype=object)
+    cols = {
+        TIMESTAMP_FIELD: np.array(ts, dtype=np.int64),
+        "u": u,
+        "v": np.array(vals, dtype=np.int64),
+        "_key": hash_columns([u]),
+    }
+    if retracts is not None:
+        cols[IS_RETRACT_FIELD] = np.array(retracts, dtype=bool)
+    return Batch(cols)
+
+
+def test_retract_append_stream():
+    op, _cfg, ctx, col = make_op()
+    op.process_batch(keyed_batch([0, 1], ["a", "a"], [1, 2]), ctx, col)
+    op.handle_watermark(Watermark.event_time(1), ctx, col)
+    rows = rows_of(col)
+    # first flush: single append
+    assert len(rows) == 1
+    assert rows[0]["u"] == "a" and rows[0]["cnt"] == 2 and rows[0]["total"] == 3
+    assert rows[0][IS_RETRACT_FIELD] is False
+    op.process_batch(keyed_batch([2], ["a"], [10]), ctx, col)
+    op.handle_watermark(Watermark.event_time(2), ctx, col)
+    rows = rows_of(col)
+    # second flush: retract old value, append new
+    assert len(rows) == 3
+    assert rows[1][IS_RETRACT_FIELD] is True and rows[1]["cnt"] == 2 and rows[1]["total"] == 3
+    assert rows[2][IS_RETRACT_FIELD] is False and rows[2]["cnt"] == 3 and rows[2]["total"] == 13
+    # materialized view has exactly one live row
+    final = merge_updating_rows(rows)
+    assert final == [{"u": "a", "cnt": 3, "total": 13}]
+
+
+def test_noop_update_suppressed():
+    op, _cfg, ctx, col = make_op(aggs=[("mx", "max", Col("v"))])
+    op.process_batch(keyed_batch([0], ["a"], [5]), ctx, col)
+    op.handle_watermark(Watermark.event_time(1), ctx, col)
+    op.process_batch(keyed_batch([2], ["a"], [3]), ctx, col)  # max unchanged
+    op.handle_watermark(Watermark.event_time(3), ctx, col)
+    rows = rows_of(col)
+    assert len(rows) == 1  # no retract/append pair for the unchanged max
+
+
+def test_updating_input_retraction():
+    op, _cfg, ctx, col = make_op()
+    op.process_batch(keyed_batch([0, 0], ["a", "a"], [1, 2]), ctx, col)
+    op.handle_watermark(Watermark.event_time(0), ctx, col)
+    # retract the v=2 row (e.g. upstream updating join removed it)
+    op.process_batch(keyed_batch([1], ["a"], [2], retracts=[True]), ctx, col)
+    op.handle_watermark(Watermark.event_time(1), ctx, col)
+    final = merge_updating_rows(rows_of(col))
+    assert final == [{"u": "a", "cnt": 1, "total": 1}]
+
+
+def test_retract_to_zero_deletes_key():
+    op, _cfg, ctx, col = make_op()
+    op.process_batch(keyed_batch([0], ["a"], [7]), ctx, col)
+    op.handle_watermark(Watermark.event_time(0), ctx, col)
+    op.process_batch(keyed_batch([1], ["a"], [7], retracts=[True]), ctx, col)
+    op.handle_watermark(Watermark.event_time(1), ctx, col)
+    assert merge_updating_rows(rows_of(col)) == []
+    assert op.state == {}
+
+
+def test_min_over_updating_input_rejected():
+    op, _cfg, ctx, col = make_op(aggs=[("mn", "min", Col("v"))])
+    with pytest.raises(ValueError, match="invertible"):
+        op.process_batch(keyed_batch([0], ["a"], [1], retracts=[True]), ctx, col)
+
+
+def test_ttl_eviction_emits_retraction():
+    op, _cfg, ctx, col = make_op(ttl=1000)
+    op.process_batch(keyed_batch([0], ["a"], [1]), ctx, col)
+    op.handle_watermark(Watermark.event_time(0), ctx, col)
+    assert len(rows_of(col)) == 1
+    # advance far past ttl; key a evicted with a retraction
+    op.process_batch(keyed_batch([10_000], ["b"], [2]), ctx, col)
+    op.handle_watermark(Watermark.event_time(10_000), ctx, col)
+    final = merge_updating_rows(rows_of(col))
+    assert final == [{"u": "b", "cnt": 1, "total": 2}]
+
+
+def test_updating_checkpoint_restore(tmp_path):
+    storage = str(tmp_path / "upd")
+    op, cfg, _ctx, col = make_op(storage=storage)
+    ti = TaskInfo("j", "upd", "updating_aggregate", 0, 1)
+    tm = TableManager(ti, storage)
+    ctx = OperatorContext(ti, None, tm)
+    op.process_batch(keyed_batch([0, 1], ["a", "b"], [1, 2]), ctx, col)
+    op.handle_watermark(Watermark.event_time(1), ctx, col)  # flush -> emitted set
+    op.handle_checkpoint(None, ctx, col)
+    tm.checkpoint(1, 1)
+
+    op2 = UpdatingAggregate(cfg)
+    tm2 = TableManager(ti, storage)
+    tm2.restore(1, op2.tables())
+    ctx2 = OperatorContext(ti, None, tm2)
+    col2 = FakeCollector()
+    op2.on_start(ctx2)
+    op2.process_batch(keyed_batch([2], ["a"], [10]), ctx2, col2)
+    op2.handle_watermark(Watermark.event_time(2), ctx2, col2)
+    rows = rows_of(col2)
+    # restored `emitted` state means the new value retracts the OLD emission
+    assert len(rows) == 2
+    assert rows[0][IS_RETRACT_FIELD] is True and rows[0]["cnt"] == 1 and rows[0]["total"] == 1
+    assert rows[1][IS_RETRACT_FIELD] is False and rows[1]["cnt"] == 2 and rows[1]["total"] == 11

@@ -1,0 +1,121 @@
+"""SQL OVER window functions: row_number/rank/dense_rank and partition
+aggregates, bucketed by event timestamp, emitted on watermark."""
+
+import numpy as np
+
+from arroyo_tpu.batch import Batch, TIMESTAMP_FIELD
+from arroyo_tpu.expr import Col
+from arroyo_tpu.operators.base import OperatorContext
+from arroyo_tpu.operators.window_fn import WindowFunctionOperator
+from arroyo_tpu.state.tables import TableManager
+from arroyo_tpu.types import TaskInfo, Watermark
+
+
+class FakeCollector:
+    def __init__(self):
+        self.batches = []
+
+    def collect(self, b):
+        self.batches.append(b)
+
+    def broadcast(self, s):
+        pass
+
+
+def rows_of(col):
+    out = []
+    for b in col.batches:
+        out.extend(b.to_pylist())
+    return out
+
+
+def make_op(functions, partition=("g",), order_by=None):
+    op = WindowFunctionOperator({
+        "partition_fields": list(partition),
+        "order_by": order_by if order_by is not None else [(Col("v"), False)],
+        "functions": functions,
+    })
+    ti = TaskInfo("j", "wf", "window_function", 0, 1)
+    ctx = OperatorContext(ti, None, TableManager(ti, "/tmp/wf-unused"))
+    return op, ctx, FakeCollector()
+
+
+def batch(ts, gs, vs):
+    return Batch({
+        TIMESTAMP_FIELD: np.array(ts, dtype=np.int64),
+        "g": np.array(gs, dtype=object),
+        "v": np.array(vs, dtype=np.int64),
+    })
+
+
+def test_row_number_desc_per_partition():
+    op, ctx, col = make_op([("rn", "row_number", None)])
+    op.process_batch(batch([100] * 6, ["a", "a", "a", "b", "b", "b"],
+                           [5, 9, 1, 4, 8, 6]), ctx, col)
+    op.handle_watermark(Watermark.event_time(101), ctx, col)
+    rows = rows_of(col)
+    got = {(r["g"], r["v"]): r["rn"] for r in rows}
+    assert got == {("a", 9): 1, ("a", 5): 2, ("a", 1): 3,
+                   ("b", 8): 1, ("b", 6): 2, ("b", 4): 3}
+
+
+def test_rank_and_dense_rank_with_ties():
+    op, ctx, col = make_op([("rk", "rank", None), ("dr", "dense_rank", None)])
+    op.process_batch(batch([100] * 5, ["a"] * 5, [9, 9, 5, 5, 1]), ctx, col)
+    op.on_close(ctx, col)
+    rows = sorted(rows_of(col), key=lambda r: (-r["v"], r["rk"]))
+    assert [(r["v"], r["rk"], r["dr"]) for r in rows] == [
+        (9, 1, 1), (9, 1, 1), (5, 3, 2), (5, 3, 2), (1, 5, 3)]
+
+
+def test_partition_aggregates():
+    op, ctx, col = make_op([
+        ("total", "sum", Col("v")), ("n", "count", None), ("avg_v", "avg", Col("v")),
+    ])
+    op.process_batch(batch([100] * 4, ["a", "a", "b", "b"], [1, 3, 10, 20]), ctx, col)
+    op.on_close(ctx, col)
+    rows = rows_of(col)
+    for r in rows:
+        if r["g"] == "a":
+            assert r["total"] == 4 and r["n"] == 2 and r["avg_v"] == 2.0
+        else:
+            assert r["total"] == 30 and r["n"] == 2 and r["avg_v"] == 15.0
+
+
+def test_buckets_independent():
+    """Separate timestamps (separate windows) rank independently."""
+    op, ctx, col = make_op([("rn", "row_number", None)])
+    op.process_batch(batch([100, 100, 200, 200], ["a"] * 4, [5, 9, 7, 2]), ctx, col)
+    op.on_close(ctx, col)
+    rows = rows_of(col)
+    got = {(r[TIMESTAMP_FIELD], r["v"]): r["rn"] for r in rows}
+    assert got == {(100, 9): 1, (100, 5): 2, (200, 7): 1, (200, 2): 2}
+
+
+def test_checkpoint_restore(tmp_path):
+    storage = str(tmp_path / "wf")
+    cfg = {
+        "partition_fields": ["g"],
+        "order_by": [(Col("v"), False)],
+        "functions": [("rn", "row_number", None)],
+    }
+    ti = TaskInfo("j", "wf", "window_function", 0, 1)
+    tm = TableManager(ti, storage)
+    ctx = OperatorContext(ti, None, tm)
+    op = WindowFunctionOperator(cfg)
+    col = FakeCollector()
+    op.process_batch(batch([100], ["a"], [5]), ctx, col)
+    op.handle_checkpoint(None, ctx, col)
+    tm.checkpoint(1, None)
+
+    op2 = WindowFunctionOperator(cfg)
+    tm2 = TableManager(ti, storage)
+    tm2.restore(1, op2.tables())
+    ctx2 = OperatorContext(ti, None, tm2)
+    col2 = FakeCollector()
+    op2.on_start(ctx2)
+    op2.process_batch(batch([100], ["a"], [9]), ctx2, col2)
+    op2.on_close(ctx2, col2)
+    rows = rows_of(col2)
+    got = {r["v"]: r["rn"] for r in rows}
+    assert got == {9: 1, 5: 2}
