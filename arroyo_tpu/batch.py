@@ -35,6 +35,9 @@ _NUMPY_DTYPES = {
     "float32": np.float32,
     "float64": np.float64,
     "bool": np.bool_,
+    # event-time micros; int64 on device, ISO-8601 strings at the
+    # format boundary (json_fmt parses/formats by schema dtype)
+    "timestamp": np.int64,
 }
 
 

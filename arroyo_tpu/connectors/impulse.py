@@ -96,6 +96,8 @@ class ImpulseSource(SourceOperator):
                     if r is not None:
                         return r
                     time.sleep(min(delay, 0.05))
+        # keep the offset table current for the run loop's final snapshot
+        tbl.insert(sub, counter)
         return SourceFinishType.GRACEFUL
 
 

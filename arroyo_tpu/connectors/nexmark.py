@@ -220,6 +220,8 @@ class NexmarkSource(SourceOperator):
                     if r is not None:
                         return r
                     time.sleep(min(delay, 0.05))
+        # keep the offset table current for the run loop's final snapshot
+        tbl.insert(sub, i)
         return SourceFinishType.GRACEFUL
 
 

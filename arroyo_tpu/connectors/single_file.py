@@ -35,6 +35,10 @@ class SingleFileSource(SourceOperator):
     def run(self, sctx, collector) -> SourceFinishType:
         ctx = sctx.ctx
         sub = ctx.task_info.subtask_index
+        if sub != 0:
+            # only subtask 0 reads the file (reference single_file/source.rs:96)
+            # so the line offset survives restores at any parallelism
+            return SourceFinishType.GRACEFUL
         tbl = ctx.table_manager.global_keyed("s")
         offset = tbl.get(sub, 0)
         de = JsonDeserializer(
@@ -46,10 +50,16 @@ class SingleFileSource(SourceOperator):
         with open(self.path) as f:
             lines = f.read().splitlines()
         # deterministic split across subtasks: round-robin by line number
-        p = ctx.task_info.parallelism
         i = offset
-        my_lines = lines[sub::p]
+        my_lines = lines
+        # test-only throttle so mid-stream checkpoints are meaningful
+        # (reference smoke tests get this from their rate-limited sources)
+        delay_us = config().get("testing.source-read-delay-micros", 0)
         while i < len(my_lines):
+            if delay_us:
+                import time as _time
+
+                _time.sleep(delay_us / 1e6)
             msg = sctx.poll_control()
             if msg is not None:
                 if msg.kind == "checkpoint":
@@ -73,6 +83,9 @@ class SingleFileSource(SourceOperator):
         b = de.flush()
         if b is not None:
             collector.collect(b)
+        # keep the offset table current: the run loop snapshots it into the
+        # "final" checkpoint after a graceful drain
+        tbl.insert(sub, i)
         return SourceFinishType.GRACEFUL
 
 
@@ -82,6 +95,7 @@ class SingleFileSink(Operator):
 
     def __init__(self, cfg: dict):
         self.path = cfg["path"]
+        self.schema = cfg.get("schema")
         self.lines: list[str] = []
 
     def tables(self):
@@ -92,7 +106,7 @@ class SingleFileSink(Operator):
         self.lines = list(tbl.get(ctx.task_info.subtask_index, []))
 
     def process_batch(self, batch, ctx, collector, input_index=0):
-        self.lines.extend(serialize_json_lines(batch))
+        self.lines.extend(serialize_json_lines(batch, self.schema))
 
     def handle_checkpoint(self, barrier, ctx, collector):
         ctx.table_manager.global_keyed("out").insert(

@@ -176,6 +176,7 @@ class Engine:
                 key = (resp.node_id, resp.subtask_index)
                 if resp.kind == "task_finished":
                     self._finished_tasks.add(key)
+                    self._finish_ready_epochs()
                 elif resp.kind == "task_failed":
                     self._failed.append(resp)
                     # propagate: unstick every surviving task so producers
@@ -186,13 +187,24 @@ class Engine:
                 elif resp.kind == "checkpoint_completed":
                     ep = self._checkpoints.setdefault(resp.epoch, {})
                     ep[key] = resp.subtask_metadata
-                    if len(ep) == self._n_tasks:
-                        write_job_checkpoint_metadata(
-                            self.storage_url, self.job_id, resp.epoch,
-                            {"operators": list({k[0] for k in ep})},
-                        )
-                        self._completed_epochs.add(resp.epoch)
+                    self._finish_ready_epochs()
                 self._cond.notify_all()
+
+    def _finish_ready_epochs(self) -> None:
+        """An epoch is complete once every task has snapshotted it or
+        finished outright (a drained source can't take part in a barrier —
+        its state is final; reference CheckpointState handles TaskFinished
+        the same way). Caller holds the lock."""
+        for epoch, ep in self._checkpoints.items():
+            if epoch in self._completed_epochs or not ep:
+                continue
+            covered = set(ep) | self._finished_tasks
+            if len(covered) >= self._n_tasks:
+                write_job_checkpoint_metadata(
+                    self.storage_url, self.job_id, epoch,
+                    {"operators": list({k[0] for k in ep})},
+                )
+                self._completed_epochs.add(epoch)
 
     # -------------------------------------------------------------- control
 
@@ -206,12 +218,16 @@ class Engine:
             t.control_queue.put(ControlMessage(kind="checkpoint", barrier=barrier))
 
     def checkpoint_and_wait(self, epoch: int, timeout: float = 60.0, then_stop: bool = False) -> bool:
+        """True once every subtask snapshotted ``epoch``; False if the
+        pipeline finished first (sources already drained) or on timeout."""
         self.trigger_checkpoint(epoch, then_stop=then_stop)
         deadline = time.monotonic() + timeout
         with self._lock:
             while epoch not in self._completed_epochs:
                 if self._failed:
                     raise RuntimeError(f"task failed during checkpoint: {self._failed[0].error}")
+                if len(self._finished_tasks) >= self._n_tasks:
+                    return False
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False

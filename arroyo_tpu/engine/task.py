@@ -139,6 +139,10 @@ class Task:
         finish = op.run(sctx, self.collector)
         op.on_close(self.ctx, self.collector)
         if finish == SourceFinishType.GRACEFUL:
+            # persist the drained offset so a restore from ANY later epoch
+            # does not replay this source (state is constant after EOF and
+            # all emitted data precedes downstream epoch barriers)
+            self.ctx.table_manager.checkpoint("final", self.ctx.watermark())
             self.collector.broadcast(Signal.end_of_data())
         elif finish == SourceFinishType.IMMEDIATE:
             self.collector.broadcast(Signal.stop())
@@ -266,5 +270,8 @@ class Task:
             elif sig.kind == SignalKind.STOP:
                 self.collector.broadcast(Signal.stop())
                 break
-            if stopping and not pending:
+            if stopping:
+                # checkpoint-then-stop: everything after the stopping barrier
+                # (held items, EndOfData) is post-snapshot and must NOT be
+                # processed — it would mutate state past what was persisted
                 break

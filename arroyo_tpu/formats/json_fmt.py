@@ -16,9 +16,43 @@ import numpy as np
 
 from ..batch import STRING, TIMESTAMP_FIELD, Batch, Field, Schema
 
+IS_RETRACT_FIELD = "_is_retract"
+
 
 class BadDataError(ValueError):
     pass
+
+
+def parse_iso_micros(v) -> int:
+    """ISO-8601 datetime (or epoch-micros int) -> int64 micros since epoch."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    from datetime import datetime, timezone
+
+    s = str(v)
+    if s.endswith("Z"):
+        s = s[:-1] + "+00:00"
+    dt = datetime.fromisoformat(s)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return int(dt.timestamp() * 1_000_000)
+
+
+def format_iso_micros(us: int) -> str:
+    """int64 micros -> naive-UTC ISO string; fraction printed at millisecond
+    precision when it is whole millis, microseconds otherwise, omitted when
+    zero (matches arrow's display of timestamp columns)."""
+    from datetime import datetime, timezone
+
+    us = int(us)
+    dt = datetime.fromtimestamp(us // 1_000_000, tz=timezone.utc)
+    base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+    frac = us % 1_000_000
+    if frac == 0:
+        return base
+    if frac % 1000 == 0:
+        return f"{base}.{frac // 1000:03d}"
+    return f"{base}.{frac:06d}"
 
 
 class JsonDeserializer:
@@ -77,7 +111,11 @@ class JsonDeserializer:
             if f.name == TIMESTAMP_FIELD:
                 continue
             vals = [r.get(f.name) for r in rows]
-            if f.dtype == STRING:
+            if f.dtype == "timestamp":
+                cols[f.name] = np.array(
+                    [0 if v is None else parse_iso_micros(v) for v in vals], dtype=np.int64
+                )
+            elif f.dtype == STRING:
                 cols[f.name] = np.array(
                     [None if v is None else str(v) for v in vals], dtype=object
                 )
@@ -100,13 +138,26 @@ class JsonDeserializer:
         return Batch(cols)
 
 
-def serialize_json_lines(batch: Batch, include_internal: bool = False) -> list[str]:
+def serialize_json_lines(
+    batch: Batch, schema: Optional[Schema] = None, include_internal: bool = False
+) -> list[str]:
+    """Batch -> JSON lines. With a schema, timestamp columns format as ISO
+    strings. Updating batches (_is_retract present) serialize as Debezium
+    envelopes {"before","after","op"} (reference ser.rs debezium path)."""
     names = [
         n
         for n in batch.columns
-        if include_internal or not n.startswith("_")
+        if (include_internal or not n.startswith("_")) and n != IS_RETRACT_FIELD
     ]
+    ts_fields = set()
+    if schema is not None:
+        ts_fields = {f.name for f in schema.fields if f.dtype == "timestamp"}
     cols = [batch.columns[n] for n in names]
+    retracts = (
+        np.asarray(batch.columns[IS_RETRACT_FIELD], dtype=bool)
+        if IS_RETRACT_FIELD in batch.columns
+        else None
+    )
     out = []
     for i in range(batch.num_rows):
         obj = {}
@@ -116,6 +167,13 @@ def serialize_json_lines(batch: Batch, include_internal: bool = False) -> list[s
                 v = v.item()
             if isinstance(v, float) and v != v:  # NaN -> null
                 v = None
+            if n in ts_fields and v is not None:
+                v = format_iso_micros(v)
             obj[n] = v
+        if retracts is not None:
+            if retracts[i]:
+                obj = {"before": obj, "after": None, "op": "d"}
+            else:
+                obj = {"before": None, "after": obj, "op": "c"}
         out.append(json.dumps(obj, separators=(",", ":"), default=str))
     return out

@@ -53,6 +53,9 @@ class ValueOperator(Operator):
             cols[TIMESTAMP_FIELD] = batch.timestamps
         if KEY_FIELD in batch.columns and KEY_FIELD not in cols:
             cols[KEY_FIELD] = batch.keys
+        # updating streams: the retract flag rides along through projections
+        if "_is_retract" in batch.columns and "_is_retract" not in cols:
+            cols["_is_retract"] = batch.columns["_is_retract"]
         collector.collect(Batch(cols))
 
 

@@ -1,0 +1,19 @@
+"""SQL frontend: SQL text -> logical dataflow Graph.
+
+TPU-native replacement for the reference's planner crate
+(crates/arroyo-planner — parse_and_get_program, lib.rs:534): instead of a
+forked DataFusion producing serialized physical plans, a self-contained
+lexer/parser/planner compiles SQL directly to the Graph IR whose operator
+bodies are the jax/Pallas window runtime (arroyo_tpu.ops) and the expression
+AST (arroyo_tpu.expr).
+
+Scope mirrors what the reference's smoke-test suite exercises: connector DDL
+with event-time/watermark options, projections/filters, tumble/hop/session
+window aggregates, updating (non-windowed) aggregates, stream-stream windowed
+and updating joins, SQL window functions (OVER), views, and INSERT INTO.
+"""
+
+from .parser import parse_statements
+from .planner import PlanError, Planner, plan_query
+
+__all__ = ["parse_statements", "plan_query", "Planner", "PlanError"]

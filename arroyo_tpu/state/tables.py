@@ -27,21 +27,89 @@ from typing import Any, Iterable, Optional
 
 import numpy as np
 
-# pyarrow's internal IO thread pool has shown flaky segfaults when many
-# engine task threads write checkpoints while another engine restores in the
-# same process (the smoke-test pattern); parquet IO is off the hot path, so
-# serialize it and keep arrow single-threaded.
+# pyarrow's IO paths have shown flaky segfaults when many engine task
+# threads checkpoint while another engine restores in the same process (the
+# smoke-test pattern, even with use_threads=False and a module-global lock);
+# the default columnar checkpoint codec is therefore pure-numpy .npz, with
+# parquet available via ``checkpoint.file-format = "parquet"`` for
+# production deployments that want reference-compatible state files.
 _PARQUET_IO_LOCK = threading.Lock()
 
 from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch, Schema
 from ..types import TaskInfo
 
 
-def checkpoint_dir(storage_url: str, job_id: str, epoch: int) -> str:
-    return os.path.join(storage_url, job_id, "checkpoints", f"checkpoint-{epoch:07d}")
+def _checkpoint_format() -> str:
+    from ..config import config
+
+    return config().get("checkpoint.file-format", "npz")
 
 
-def operator_dir(storage_url: str, job_id: str, epoch: int, node_id: str) -> str:
+def write_columnar(path: str, columns: dict) -> None:
+    """Write named columns to ``path`` in the configured codec. Object
+    (string) columns round-trip via a pickled sidecar entry."""
+    if _checkpoint_format() == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        arrays, names = [], []
+        for name, col in columns.items():
+            names.append(name)
+            if col.dtype == object:
+                arrays.append(
+                    pa.array([None if v is None else str(v) for v in col], type=pa.string())
+                )
+            else:
+                arrays.append(pa.array(col))
+        with _PARQUET_IO_LOCK:
+            pq.write_table(pa.table(arrays, names=names), path)
+        return
+    dense = {}
+    objcols: dict[str, list] = {}
+    for name, col in columns.items():
+        if col.dtype == object:
+            # keep python values as-is (ints stay ints); only unwrap numpy
+            # scalars so the pickle round-trips cleanly
+            objcols[name] = [v.item() if isinstance(v, np.generic) else v for v in col]
+        else:
+            dense[name] = col
+    if objcols:
+        dense["__objcols__"] = np.frombuffer(pickle.dumps(objcols), dtype=np.uint8)
+    with open(path, "wb") as f:
+        np.savez(f, **dense)
+
+
+def read_columnar(path: str) -> dict:
+    if _checkpoint_format() == "parquet":
+        import pyarrow.parquet as pq
+
+        with _PARQUET_IO_LOCK:
+            table = pq.read_table(path, use_threads=False)
+        cols: dict[str, np.ndarray] = {}
+        for name in table.column_names:
+            arr = table.column(name)
+            if str(arr.type) in ("string", "large_string"):
+                cols[name] = np.array(arr.to_pylist(), dtype=object)
+            else:
+                cols[name] = np.asarray(arr.to_numpy(zero_copy_only=False))
+        return cols
+    with open(path, "rb") as f:
+        data = np.load(f, allow_pickle=False)
+        cols = {name: data[name] for name in data.files if name != "__objcols__"}
+        if "__objcols__" in data.files:
+            objcols = pickle.loads(data["__objcols__"].tobytes())
+            for name, vals in objcols.items():
+                cols[name] = np.array(vals, dtype=object)
+    return cols
+
+
+def checkpoint_dir(storage_url: str, job_id: str, epoch) -> str:
+    """epoch: int, or the string "final" for drained-source snapshots."""
+    name = f"checkpoint-{epoch:07d}" if isinstance(epoch, int) else f"checkpoint-{epoch}"
+    return os.path.join(storage_url, job_id, "checkpoints", name)
+
+
+def operator_dir(storage_url: str, job_id: str, epoch, node_id: str) -> str:
     return os.path.join(checkpoint_dir(storage_url, job_id, epoch), f"operator-{node_id}")
 
 
@@ -117,21 +185,10 @@ class ExpiringTimeKeyTable:
     # -- checkpoint ---------------------------------------------------------
 
     def write_checkpoint(self, path: str) -> Optional[dict]:
-        import pyarrow as pa
-        import pyarrow.parquet as pq
-
         if not self.batches:
             return None
         merged = Batch.concat(self.batches)
-        arrays, names = [], []
-        for name, col in merged.columns.items():
-            names.append(name)
-            if col.dtype == object:
-                arrays.append(pa.array([None if v is None else str(v) for v in col], type=pa.string()))
-            else:
-                arrays.append(pa.array(col))
-        with _PARQUET_IO_LOCK:
-            pq.write_table(pa.table(arrays, names=names), path)
+        write_columnar(path, merged.columns)
         ts = merged.timestamps
         meta = {
             "file": os.path.basename(path),
@@ -152,8 +209,6 @@ class ExpiringTimeKeyTable:
         watermark_micros: Optional[int],
     ) -> None:
         """Restore: read files overlapping our key range & retention window."""
-        import pyarrow.parquet as pq
-
         cutoff = None
         if watermark_micros is not None and self.retention_micros:
             cutoff = watermark_micros - self.retention_micros
@@ -163,15 +218,7 @@ class ExpiringTimeKeyTable:
                 continue
             if "min_key" in meta and (meta["min_key"] > hi or meta["max_key"] < lo):
                 continue
-            with _PARQUET_IO_LOCK:
-                table = pq.read_table(path, use_threads=False)
-            cols: dict[str, np.ndarray] = {}
-            for name in table.column_names:
-                arr = table.column(name)
-                if arr.type == "string" or str(arr.type) in ("string", "large_string"):
-                    cols[name] = np.array(arr.to_pylist(), dtype=object)
-                else:
-                    cols[name] = np.asarray(arr.to_numpy(zero_copy_only=False))
+            cols = read_columnar(path)
             batch = Batch(cols)
             if KEY_FIELD in batch:
                 keys = batch.keys
@@ -222,8 +269,9 @@ class TableManager:
             meta = table.write_checkpoint(os.path.join(opdir, f"table-{name}-{sub}.bin"))
             meta["table"] = name
             files.append(meta)
+        ext = "parquet" if _checkpoint_format() == "parquet" else "npz"
         for name, table in self.expiring.items():
-            meta = table.write_checkpoint(os.path.join(opdir, f"table-{name}-{sub}.parquet"))
+            meta = table.write_checkpoint(os.path.join(opdir, f"table-{name}-{sub}.{ext}"))
             if meta is not None:
                 meta["table"] = name
                 meta["retention_micros"] = table.retention_micros
@@ -240,16 +288,38 @@ class TableManager:
 
     def restore(self, epoch: int, table_specs: list) -> Optional[int]:
         """Load state written at ``epoch`` (possibly at different parallelism).
-        Returns the restored watermark (min across prior subtasks), if any."""
+
+        Subtasks absent from the epoch snapshot (they drained before the
+        barrier — e.g. a source that hit EOF) are filled from the "final"
+        snapshot written at graceful finish: a drained task's state is
+        constant after EOF, and everything it emitted was processed by
+        downstream tasks before their epoch barriers, so its final state is
+        consistent with any later epoch.
+        Returns the restored watermark (min across prior subtasks), if any.
+        """
         ti = self.task_info
+
+        def read_metas(d: str) -> list:
+            out = []
+            if not os.path.isdir(d):
+                return out
+            for fn in sorted(os.listdir(d)):
+                if fn.startswith("metadata-") and fn.endswith(".json"):
+                    with open(os.path.join(d, fn)) as f:
+                        m = json.load(f)
+                    m["__dir__"] = d
+                    out.append(m)
+            return out
+
         opdir = operator_dir(self.storage_url, ti.job_id, epoch, ti.node_id)
-        if not os.path.isdir(opdir):
+        metas = read_metas(opdir)
+        have_subtasks = {m["subtask_index"] for m in metas}
+        final_dir = operator_dir(self.storage_url, ti.job_id, "final", ti.node_id)
+        metas += [
+            m for m in read_metas(final_dir) if m["subtask_index"] not in have_subtasks
+        ]
+        if not metas:
             return None
-        metas = []
-        for fn in sorted(os.listdir(opdir)):
-            if fn.startswith("metadata-") and fn.endswith(".json"):
-                with open(os.path.join(opdir, fn)) as f:
-                    metas.append(json.load(f))
         watermarks = [m["watermark_micros"] for m in metas if m.get("watermark_micros") is not None]
         restored_wm = min(watermarks) if watermarks else None
         spec_by_name = {s.name: s for s in table_specs}
@@ -257,7 +327,7 @@ class TableManager:
         for m in metas:
             for fmeta in m["files"]:
                 by_table.setdefault(fmeta["table"], []).append(
-                    (os.path.join(opdir, fmeta["file"]), fmeta)
+                    (os.path.join(m["__dir__"], fmeta["file"]), fmeta)
                 )
         for tname, entries in by_table.items():
             spec = spec_by_name.get(tname)

@@ -1,0 +1,429 @@
+#!/usr/bin/env python
+"""Generate smoke-test inputs and golden outputs.
+
+The golden outputs are computed by independent plain-Python oracles (dict
+loops, no engine code), mirroring how the reference pins behavior with
+golden files (crates/arroyo-sql-testing/golden_outputs). Re-run after
+changing inputs or adding queries:  python tests/smoke/generate.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import defaultdict
+from datetime import datetime, timezone
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+INPUTS = os.path.join(HERE, "inputs")
+GOLDEN = os.path.join(HERE, "golden")
+
+BASE = 1696871600 * 1_000_000  # 2023-10-09T17:13:20Z
+S = 1_000_000
+
+
+def iso(us: int) -> str:
+    dt = datetime.fromtimestamp(us // S, tz=timezone.utc)
+    base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+    frac = us % S
+    if frac == 0:
+        return base
+    if frac % 1000 == 0:
+        return f"{base}.{frac // 1000:03d}"
+    return f"{base}.{frac:06d}"
+
+
+def iso_tz(us: int) -> str:
+    return iso(us) + "+00:00"
+
+
+# --------------------------------------------------------------------------
+# inputs
+
+
+def gen_impulse():
+    rows = []
+    for i in range(400):
+        ts = BASE + i * 200_000
+        rows.append({"timestamp": iso_tz(ts), "counter": i, "subtask_index": 0})
+    return rows
+
+
+def gen_cars():
+    rows = []
+    for i in range(300):
+        ts = BASE + i * 250_000
+        rows.append({
+            "timestamp": iso_tz(ts),
+            "driver_id": 100 + i % 7,
+            "event_type": "pickup" if i % 2 == 0 else "dropoff",
+            "location": f"loc_{i % 5}",
+        })
+    return rows
+
+
+def gen_bids():
+    rows = []
+    for i in range(600):
+        ts = BASE + i * 100_000
+        rows.append({
+            "datetime": iso_tz(ts),
+            "auction": 1000 + ((i * 7) % 5) * 100,
+            "price": (i * 13) % 1000 + 1,
+            "bidder": f"b{i % 11}",
+        })
+    return rows
+
+
+def gen_orders():
+    rows = []
+    for i in range(120):
+        ts = BASE + i * 500_000
+        rows.append({
+            "timestamp": iso_tz(ts),
+            "order_id": i,
+            "customer_id": i % 10,
+            "amount": (i * 37) % 500,
+        })
+    return rows
+
+
+def gen_customers():
+    rows = []
+    for i in range(15):
+        ts = BASE + i * 3_000_000
+        rows.append({"timestamp": iso_tz(ts), "customer_id": i, "name": f"cust_{i}"})
+    return rows
+
+
+def input_ts(row, field):
+    s = row[field].replace("+00:00", "")
+    dt = datetime.fromisoformat(s).replace(tzinfo=timezone.utc)
+    return int(dt.timestamp() * S)
+
+
+# --------------------------------------------------------------------------
+# window helpers
+
+
+def tumble_start(ts: int, width: int) -> int:
+    return (ts // width) * width
+
+
+def hop_starts(ts: int, slide: int, width: int):
+    first = ((ts - width) // slide + 1) * slide
+    if first > ts:
+        first -= slide
+    starts = []
+    s = max(first, ((ts - width) // slide + 1) * slide)
+    # all starts s with s <= ts < s + width, s multiple of slide
+    k = (ts - width) // slide + 1
+    while k * slide <= ts:
+        if ts < k * slide + width:
+            starts.append(k * slide)
+        k += 1
+    return starts
+
+
+def sessions(ts_list: list[int], gap: int):
+    """Sorted event times -> list of (start, end, count-slice indices)."""
+    out = []
+    cur = None
+    for t in sorted(ts_list):
+        if cur is None or t - cur[1] > gap:
+            if cur is not None:
+                out.append(cur)
+            cur = [t, t, 1]
+        else:
+            cur[1] = t
+            cur[2] += 1
+    if cur is not None:
+        out.append(cur)
+    return [(s, e + gap, n) for s, e, n in out]
+
+
+# --------------------------------------------------------------------------
+# oracles (one per query)
+
+
+def o_select_star(ins):
+    return [dict(r, timestamp=iso(input_ts(r, "timestamp"))) for r in ins["cars"]]
+
+
+def o_expressions(ins):
+    out = []
+    for r in ins["impulse"]:
+        c = r["counter"]
+        if not (10 <= c < 60):
+            continue
+        if 30 <= c <= 39:
+            continue
+        out.append({
+            "c": c,
+            "doubled": c * 2,
+            "parity": "even" if c % 2 == 0 else "odd",
+            "clamped": c ** 0.5,
+            "label": f"row_{c}",
+        })
+    return out
+
+
+def o_tumbling_aggregates(ins):
+    W = 10 * S
+    byw = defaultdict(list)
+    for r in ins["impulse"]:
+        byw[tumble_start(input_ts(r, "timestamp"), W)].append(r["counter"])
+    out = []
+    for w, cs in sorted(byw.items()):
+        out.append({
+            "start": iso(w), "end": iso(w + W), "rows": len(cs),
+            "total": sum(cs), "min_c": min(cs), "max_c": max(cs),
+            "avg_c": sum(cs) / len(cs),
+        })
+    return out
+
+
+def o_grouped_aggregates(ins):
+    W = 10 * S
+    byk = defaultdict(list)
+    for r in ins["impulse"]:
+        k = (tumble_start(input_ts(r, "timestamp"), W), r["counter"] % 3)
+        byk[k].append(r["counter"])
+    return [
+        {"start": iso(w), "g": g, "rows": len(cs), "total": sum(cs)}
+        for (w, g), cs in sorted(byk.items())
+    ]
+
+
+def o_sliding_window(ins):
+    slide, width = 2 * S, 10 * S
+    byk = defaultdict(list)
+    for r in ins["bids"]:
+        ts = input_ts(r, "datetime")
+        for s in hop_starts(ts, slide, width):
+            byk[(s, r["auction"])].append(r["price"])
+    return [
+        {"start": iso(s), "end": iso(s + width), "auction": a,
+         "bids": len(ps), "top_price": max(ps)}
+        for (s, a), ps in sorted(byk.items())
+    ]
+
+
+def o_session_window(ins):
+    gap = 20 * S
+    byu = defaultdict(list)
+    for r in ins["impulse"]:
+        u = 0 if r["counter"] % 10 == 0 else r["counter"]
+        byu[u].append(input_ts(r, "timestamp"))
+    out = []
+    for u, ts_list in sorted(byu.items()):
+        for s, e, n in sessions(ts_list, gap):
+            out.append({"start": iso(s), "end": iso(e), "user_id": u, "rows": n})
+    return out
+
+
+def _hop_counts(bids):
+    slide, width = 2 * S, 10 * S
+    byk = defaultdict(int)
+    for r in bids:
+        ts = input_ts(r, "datetime")
+        for s in hop_starts(ts, slide, width):
+            byk[(s, r["auction"])] += 1
+    return byk
+
+
+def o_nexmark_q5(ins):
+    byk = _hop_counts(ins["bids"])
+    maxn = defaultdict(int)
+    for (w, _a), n in byk.items():
+        maxn[w] = max(maxn[w], n)
+    return [
+        {"auction": a, "count": n}
+        for (w, a), n in sorted(byk.items())
+        if n >= maxn[w]
+    ]
+
+
+def o_windowed_inner_join(ins):
+    W = 20 * S
+    pick = defaultdict(int)
+    drop = defaultdict(int)
+    for r in ins["cars"]:
+        k = (tumble_start(input_ts(r, "timestamp"), W), r["driver_id"])
+        if r["event_type"] == "pickup":
+            pick[k] += 1
+        else:
+            drop[k] += 1
+    out = []
+    for (w, d), p in sorted(pick.items()):
+        if (w, d) in drop:
+            out.append({"start": iso(w), "driver_id": d, "pickups": p,
+                        "dropoffs": drop[(w, d)]})
+    return out
+
+
+def o_windowed_full_join(ins):
+    W = 20 * S
+    pick = defaultdict(int)
+    drop = defaultdict(int)
+    for r in ins["cars"]:
+        k = (tumble_start(input_ts(r, "timestamp"), W), r["driver_id"])
+        if r["event_type"] == "pickup" and r["driver_id"] % 2 == 0:
+            pick[k] += 1
+        if r["event_type"] == "dropoff" and r["driver_id"] % 3 == 0:
+            drop[k] += 1
+    out = []
+    for (w, d), p in sorted(pick.items()):
+        if (w, d) in drop:
+            out.append({"driver_id": d, "other_driver": d, "pickups": p,
+                        "dropoffs": drop[(w, d)]})
+        else:
+            out.append({"driver_id": d, "other_driver": None, "pickups": p,
+                        "dropoffs": None})
+    for (w, d), dr in sorted(drop.items()):
+        if (w, d) not in pick:
+            out.append({"driver_id": None, "other_driver": d, "pickups": None,
+                        "dropoffs": dr})
+    return out
+
+
+def o_updating_aggregate(ins):
+    byg = defaultdict(list)
+    for r in ins["impulse"]:
+        byg[r["counter"] % 7].append(r["counter"])
+    return [
+        {"g": g, "c": len(cs), "total": sum(cs)} for g, cs in sorted(byg.items())
+    ]
+
+
+def o_filter_updating_aggregates(ins):
+    byg = defaultdict(int)
+    for r in ins["impulse"]:
+        byg[r["counter"] % 7] += 1
+    return [{"g": g, "c": c} for g, c in sorted(byg.items()) if c % 2 == 0]
+
+
+def o_updating_inner_join(ins):
+    names = {c["customer_id"]: c["name"] for c in ins["customers"]}
+    out = []
+    for o in ins["orders"]:
+        if o["customer_id"] in names:
+            out.append({
+                "order_id": o["order_id"], "customer_id": o["customer_id"],
+                "name": names[o["customer_id"]], "amount": o["amount"],
+            })
+    return out
+
+
+def o_updating_left_join(ins):
+    orders_by_cust = defaultdict(list)
+    for o in ins["orders"]:
+        orders_by_cust[o["customer_id"]].append(o["order_id"])
+    out = []
+    for c in ins["customers"]:
+        oids = orders_by_cust.get(c["customer_id"])
+        if oids:
+            for oid in oids:
+                out.append({"customer_id": c["customer_id"], "name": c["name"],
+                            "order_id": oid})
+        else:
+            out.append({"customer_id": c["customer_id"], "name": c["name"],
+                        "order_id": None})
+    return out
+
+
+def o_window_function(ins):
+    W = 10 * S
+    byk = defaultdict(int)
+    for r in ins["bids"]:
+        byk[(tumble_start(input_ts(r, "datetime"), W), r["auction"])] += 1
+    byw = defaultdict(list)
+    for (w, a), n in byk.items():
+        byw[w].append((a, n))
+    out = []
+    for w, pairs in sorted(byw.items()):
+        ranked = sorted(pairs, key=lambda p: (-p[1], p[0]))
+        for i, (a, n) in enumerate(ranked[:2]):
+            out.append({"start": iso(w), "auction": a, "bids": n, "row_num": i + 1})
+    return out
+
+
+def o_union_all(ins):
+    out = []
+    for r in ins["cars"]:
+        if r["event_type"] == "pickup":
+            out.append({"driver_id": r["driver_id"], "tag": "pick"})
+    for r in ins["cars"]:
+        if r["event_type"] == "dropoff":
+            out.append({"driver_id": r["driver_id"], "tag": "drop"})
+    return out
+
+
+def o_having_filter(ins):
+    W = 10 * S
+    byk = defaultdict(list)
+    for r in ins["bids"]:
+        byk[(tumble_start(input_ts(r, "datetime"), W), r["auction"])].append(r["price"])
+    return [
+        {"start": iso(w), "auction": a, "bids": len(ps),
+         "avg_price": sum(ps) / len(ps)}
+        for (w, a), ps in sorted(byk.items())
+        if len(ps) > 18
+    ]
+
+
+ORACLES = {
+    "select_star": o_select_star,
+    "expressions": o_expressions,
+    "tumbling_aggregates": o_tumbling_aggregates,
+    "grouped_aggregates": o_grouped_aggregates,
+    "sliding_window": o_sliding_window,
+    "session_window": o_session_window,
+    "nexmark_q5": o_nexmark_q5,
+    "windowed_inner_join": o_windowed_inner_join,
+    "windowed_full_join": o_windowed_full_join,
+    "updating_aggregate": o_updating_aggregate,
+    "filter_updating_aggregates": o_filter_updating_aggregates,
+    "updating_inner_join": o_updating_inner_join,
+    "updating_left_join": o_updating_left_join,
+    "window_function": o_window_function,
+    "union_all": o_union_all,
+    "having_filter": o_having_filter,
+}
+
+# queries whose sinks receive an updating stream (harness debezium-merges
+# engine output before diffing; goldens hold the final merged rows)
+UPDATING = {
+    "updating_aggregate",
+    "filter_updating_aggregates",
+    "updating_inner_join",
+    "updating_left_join",
+}
+
+
+def main():
+    os.makedirs(INPUTS, exist_ok=True)
+    os.makedirs(GOLDEN, exist_ok=True)
+    ins = {
+        "impulse": gen_impulse(),
+        "cars": gen_cars(),
+        "bids": gen_bids(),
+        "orders": gen_orders(),
+        "customers": gen_customers(),
+    }
+    for name, rows in ins.items():
+        with open(os.path.join(INPUTS, f"{name}.json"), "w") as f:
+            for r in rows:
+                f.write(json.dumps(r, separators=(",", ":")) + "\n")
+        print(f"inputs/{name}.json: {len(rows)} rows")
+    for qname, oracle in ORACLES.items():
+        rows = oracle(ins)
+        with open(os.path.join(GOLDEN, f"{qname}.json"), "w") as f:
+            for r in rows:
+                f.write(json.dumps(r, separators=(",", ":")) + "\n")
+        print(f"golden/{qname}.json: {len(rows)} rows")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE expr_output (
+  c BIGINT,
+  doubled BIGINT,
+  parity TEXT,
+  clamped DOUBLE,
+  label TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO expr_output
+SELECT
+  CAST(counter AS BIGINT) AS c,
+  CAST(counter * 2 AS BIGINT) AS doubled,
+  CASE WHEN counter % 2 = 0 THEN 'even' ELSE 'odd' END AS parity,
+  sqrt(CAST(counter AS DOUBLE)) AS clamped,
+  concat('row_', CAST(counter AS TEXT)) AS label
+FROM impulse_source
+WHERE counter >= 10 AND counter < 60 AND NOT (counter BETWEEN 30 AND 39);

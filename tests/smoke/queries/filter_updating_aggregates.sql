@@ -1,0 +1,27 @@
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE filtered_output (
+  g BIGINT,
+  c BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO filtered_output
+SELECT g, c FROM (
+  SELECT CAST(counter % 7 AS BIGINT) AS g, count(*) AS c
+  FROM impulse_source
+  GROUP BY counter % 7
+) x
+WHERE c % 2 = 0;

@@ -1,0 +1,31 @@
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE grouped_output (
+  start TIMESTAMP,
+  g BIGINT,
+  rows BIGINT,
+  total BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO grouped_output
+SELECT window.start AS start, g, rows, total FROM (
+  SELECT tumble(interval '10 seconds') AS window,
+    CAST(counter % 3 AS BIGINT) AS g,
+    count(*) AS rows,
+    CAST(sum(counter) AS BIGINT) AS total
+  FROM impulse_source
+  GROUP BY window, g
+) x;

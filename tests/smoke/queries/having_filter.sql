@@ -1,0 +1,31 @@
+CREATE TABLE bids (
+  datetime TIMESTAMP,
+  auction BIGINT,
+  price BIGINT,
+  bidder TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/bids.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'datetime'
+);
+CREATE TABLE hot_output (
+  start TIMESTAMP,
+  auction BIGINT,
+  bids BIGINT,
+  avg_price DOUBLE
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO hot_output
+SELECT window.start AS start, auction, bids, avg_price FROM (
+  SELECT tumble(interval '10 seconds') AS window, auction,
+    count(*) AS bids, avg(CAST(price AS DOUBLE)) AS avg_price
+  FROM bids
+  GROUP BY window, auction
+  HAVING count(*) > 18
+) x;

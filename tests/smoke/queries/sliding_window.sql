@@ -1,0 +1,31 @@
+CREATE TABLE bids (
+  datetime TIMESTAMP,
+  auction BIGINT,
+  price BIGINT,
+  bidder TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/bids.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'datetime'
+);
+CREATE TABLE slide_output (
+  start TIMESTAMP,
+  "end" TIMESTAMP,
+  auction BIGINT,
+  bids BIGINT,
+  top_price BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO slide_output
+SELECT window.start AS start, window.end AS "end", auction, bids, top_price FROM (
+  SELECT hop(interval '2 seconds', interval '10 seconds') AS window,
+    auction, count(*) AS bids, CAST(max(price) AS BIGINT) AS top_price
+  FROM bids
+  GROUP BY window, auction
+) x;

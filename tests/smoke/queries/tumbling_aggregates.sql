@@ -1,0 +1,36 @@
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE agg_output (
+  start TIMESTAMP,
+  "end" TIMESTAMP,
+  rows BIGINT,
+  total BIGINT,
+  min_c BIGINT,
+  max_c BIGINT,
+  avg_c DOUBLE
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO agg_output
+SELECT window.start AS start, window.end AS "end", rows, total, min_c, max_c, avg_c FROM (
+  SELECT tumble(interval '10 seconds') AS window,
+    count(*) AS rows,
+    CAST(sum(counter) AS BIGINT) AS total,
+    CAST(min(counter) AS BIGINT) AS min_c,
+    CAST(max(counter) AS BIGINT) AS max_c,
+    avg(CAST(counter AS DOUBLE)) AS avg_c
+  FROM impulse_source
+  GROUP BY window
+) x;

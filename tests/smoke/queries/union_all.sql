@@ -1,0 +1,25 @@
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE union_output (
+  driver_id BIGINT,
+  tag TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO union_output
+SELECT driver_id, 'pick' AS tag FROM cars WHERE event_type = 'pickup'
+UNION ALL
+SELECT driver_id, 'drop' AS tag FROM cars WHERE event_type = 'dropoff';

@@ -1,0 +1,26 @@
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE updating_output (
+  g BIGINT,
+  c BIGINT,
+  total BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO updating_output
+SELECT CAST(counter % 7 AS BIGINT) AS g, count(*) AS c,
+  CAST(sum(counter) AS BIGINT) AS total
+FROM impulse_source
+GROUP BY counter % 7;

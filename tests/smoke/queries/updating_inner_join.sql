@@ -1,0 +1,38 @@
+CREATE TABLE orders (
+  timestamp TIMESTAMP,
+  order_id BIGINT,
+  customer_id BIGINT,
+  amount BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/orders.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE customers (
+  timestamp TIMESTAMP,
+  customer_id BIGINT,
+  name TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/customers.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE join_output (
+  order_id BIGINT,
+  customer_id BIGINT,
+  name TEXT,
+  amount BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO join_output
+SELECT o.order_id, o.customer_id, c.name, o.amount
+FROM orders o
+JOIN customers c ON o.customer_id = c.customer_id;

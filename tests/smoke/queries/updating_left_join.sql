@@ -1,0 +1,37 @@
+CREATE TABLE orders (
+  timestamp TIMESTAMP,
+  order_id BIGINT,
+  customer_id BIGINT,
+  amount BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/orders.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE customers (
+  timestamp TIMESTAMP,
+  customer_id BIGINT,
+  name TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/customers.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE join_output (
+  customer_id BIGINT,
+  name TEXT,
+  order_id BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO join_output
+SELECT c.customer_id, c.name, o.order_id
+FROM customers c
+LEFT JOIN orders o ON c.customer_id = o.customer_id;

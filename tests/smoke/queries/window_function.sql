@@ -1,0 +1,34 @@
+CREATE TABLE bids (
+  datetime TIMESTAMP,
+  auction BIGINT,
+  price BIGINT,
+  bidder TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/bids.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'datetime'
+);
+CREATE TABLE top2_output (
+  start TIMESTAMP,
+  auction BIGINT,
+  bids BIGINT,
+  row_num BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO top2_output
+SELECT start, auction, bids, row_num FROM (
+  SELECT window.start AS start, auction, bids,
+    ROW_NUMBER() OVER (PARTITION BY window ORDER BY bids DESC, auction ASC) AS row_num
+  FROM (
+    SELECT tumble(interval '10 seconds') AS window, auction, count(*) AS bids
+    FROM bids
+    GROUP BY window, auction
+  ) counts
+) ranked
+WHERE row_num <= 2;

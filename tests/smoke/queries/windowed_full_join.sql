@@ -1,0 +1,36 @@
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE join_output (
+  driver_id BIGINT,
+  other_driver BIGINT,
+  pickups BIGINT,
+  dropoffs BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO join_output
+SELECT p.driver_id, d.driver_id AS other_driver, p.pickups, d.dropoffs
+FROM (
+  SELECT tumble(interval '20 seconds') AS window, driver_id, count(*) AS pickups
+  FROM cars WHERE event_type = 'pickup' AND driver_id % 2 = 0
+  GROUP BY window, driver_id
+) p
+FULL OUTER JOIN (
+  SELECT tumble(interval '20 seconds') AS window, driver_id, count(*) AS dropoffs
+  FROM cars WHERE event_type = 'dropoff' AND driver_id % 3 = 0
+  GROUP BY window, driver_id
+) d
+ON p.driver_id = d.driver_id AND p.window = d.window;

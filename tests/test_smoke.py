@@ -1,0 +1,171 @@
+"""SQL smoke tests: the backbone harness.
+
+Mirror of the reference's arroyo-sql-testing suite (SURVEY §4.1,
+smoke_tests.rs:33-436): every query in tests/smoke/queries runs three ways —
+(a) to completion at parallelism 1;
+(b) at parallelism 2 with checkpoints at epochs 1-3, stopping at epoch 3;
+(c) restored from epoch 3 at parallelism 3, run to completion —
+and the output is diffed (order-insensitive; updating streams are
+debezium-merged first) against golden files produced by independent oracles
+(tests/smoke/generate.py).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+import pytest
+
+SMOKE = os.path.join(os.path.dirname(__file__), "smoke")
+QUERIES = sorted(
+    os.path.splitext(os.path.basename(p))[0]
+    for p in glob.glob(os.path.join(SMOKE, "queries", "*.sql"))
+)
+
+
+def load_sql(name: str, output_path: str) -> str:
+    with open(os.path.join(SMOKE, "queries", f"{name}.sql")) as f:
+        sql = f.read()
+    return sql.replace("$input_dir", os.path.join(SMOKE, "inputs")).replace(
+        "$output_path", output_path
+    )
+
+
+def is_updating(name: str) -> bool:
+    import sys
+
+    sys.path.insert(0, SMOKE)
+    try:
+        from generate import UPDATING  # type: ignore
+
+        return name in UPDATING
+    finally:
+        sys.path.pop(0)
+
+
+def canon(row: dict) -> str:
+    """Canonical form for order-insensitive multiset comparison; floats
+    rounded so summation order doesn't flip the diff."""
+    out = {}
+    for k, v in sorted(row.items()):
+        if isinstance(v, float):
+            v = round(v, 6)
+        out[k] = v
+    return json.dumps(out, sort_keys=True)
+
+
+def merge_debezium(lines: list[dict]) -> list[dict]:
+    """Apply retract/append envelopes to a multiset (reference
+    smoke_tests.rs:475-521 merge_debezium)."""
+    counts: dict[str, int] = {}
+    rows: dict[str, dict] = {}
+    for obj in lines:
+        if "op" not in obj:
+            key = canon(obj)
+            counts[key] = counts.get(key, 0) + 1
+            rows[key] = obj
+            continue
+        if obj["op"] in ("c", "r"):
+            row = obj["after"]
+            key = canon(row)
+            counts[key] = counts.get(key, 0) + 1
+            rows[key] = row
+        elif obj["op"] == "d":
+            row = obj["before"]
+            key = canon(row)
+            if key not in counts:
+                raise AssertionError(f"retract of unseen row: {row}")
+            counts[key] -= 1
+            if counts[key] == 0:
+                del counts[key]
+        elif obj["op"] == "u":
+            bkey = canon(obj["before"])
+            counts[bkey] = counts.get(bkey, 0) - 1
+            if counts.get(bkey) == 0:
+                del counts[bkey]
+            row = obj["after"]
+            key = canon(row)
+            counts[key] = counts.get(key, 0) + 1
+            rows[key] = row
+    out = []
+    for key, n in counts.items():
+        out.extend([rows[key]] * n)
+    return out
+
+
+def read_output(path: str) -> list[dict]:
+    lines: list[dict] = []
+    for p in sorted(glob.glob(path) + glob.glob(path + ".*")):
+        with open(p) as f:
+            for line in f:
+                if line.strip():
+                    lines.append(json.loads(line))
+    return lines
+
+
+def assert_outputs(name: str, output_path: str):
+    golden_path = os.path.join(SMOKE, "golden", f"{name}.json")
+    with open(golden_path) as f:
+        golden = [json.loads(l) for l in f if l.strip()]
+    got = read_output(output_path)
+    if is_updating(name):
+        got = merge_debezium(got)
+    got_c = sorted(canon(r) for r in got)
+    want_c = sorted(canon(r) for r in golden)
+    assert got_c == want_c, (
+        f"{name}: output mismatch ({len(got_c)} rows vs {len(want_c)} golden)\n"
+        f"extra:   {[r for r in got_c if r not in want_c][:5]}\n"
+        f"missing: {[r for r in want_c if r not in got_c][:5]}"
+    )
+
+
+def build(sql: str, parallelism: int, job_id: str, restore_epoch=None):
+    from arroyo_tpu.engine.engine import Engine
+    from arroyo_tpu.sql import plan_query
+    from arroyo_tpu.sql.planner import set_parallelism
+
+    pp = plan_query(sql)
+    if parallelism > 1:
+        set_parallelism(pp.graph, parallelism)
+    eng = Engine(pp.graph, job_id=job_id, restore_epoch=restore_epoch)
+    return eng
+
+
+@pytest.mark.parametrize("name", QUERIES)
+def test_smoke(name, tmp_path, _storage):
+    from arroyo_tpu import config as cfg
+
+    # ---- run 1: parallelism 1, to completion --------------------------
+    out1 = str(tmp_path / "out1.json")
+    eng = build(load_sql(name, out1), 1, f"{name}-p1")
+    eng.run_to_completion(timeout=180)
+    assert_outputs(name, out1)
+
+    # ---- run 2: parallelism 2, checkpoints 1-3, stop at 3 -------------
+    out2 = str(tmp_path / "out2.json")
+    sql2 = load_sql(name, out2)
+    cfg.update({"testing.source-read-delay-micros": 4000})
+    stopped_mid_stream = True
+    try:
+        eng2 = build(sql2, 2, f"{name}-ckpt")
+        eng2.start()
+        for epoch in (1, 2):
+            time.sleep(0.05)
+            if not eng2.checkpoint_and_wait(epoch, timeout=60):
+                stopped_mid_stream = False  # pipeline drained before epoch
+                break
+        if stopped_mid_stream:
+            time.sleep(0.05)
+            stopped_mid_stream = eng2.checkpoint_and_wait(3, timeout=60, then_stop=True)
+        eng2.join(timeout=120)
+    finally:
+        cfg.update({"testing.source-read-delay-micros": 0})
+
+    # ---- run 3: restore from epoch 3 at parallelism 3, finish ---------
+    if stopped_mid_stream:
+        eng3 = build(sql2, 3, f"{name}-ckpt", restore_epoch=3)
+        eng3.run_to_completion(timeout=180)
+    assert_outputs(name, out2)
