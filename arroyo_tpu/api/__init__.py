@@ -1,0 +1,5 @@
+"""REST API server (reference crates/arroyo-api)."""
+
+from .server import ApiServer
+
+__all__ = ["ApiServer"]

@@ -1,0 +1,199 @@
+"""REST API: pipeline/job CRUD over the shared DB.
+
+Reference: crates/arroyo-api/src/rest.rs:127-181 route table (axum). Same
+resource model: pipelines are validated SQL; creating one starts a job; jobs
+are stopped by PATCHing desired_stop; checkpoints are queryable. Served with
+the stdlib ThreadingHTTPServer — the API is off the data path.
+
+Routes:
+  GET    /api/v1/ping
+  POST   /api/v1/pipelines/validate   {"query"}           -> {"valid", "errors"}
+  POST   /api/v1/pipelines            {"name","query","parallelism"}
+  GET    /api/v1/pipelines
+  GET    /api/v1/pipelines/{id}
+  DELETE /api/v1/pipelines/{id}
+  GET    /api/v1/pipelines/{id}/jobs
+  GET    /api/v1/jobs
+  GET    /api/v1/jobs/{id}
+  PATCH  /api/v1/jobs/{id}            {"stop": "checkpoint"|"immediate"} |
+                                      {"action": "restart"}
+  GET    /api/v1/jobs/{id}/checkpoints
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..controller.db import Database
+
+
+class ApiServer:
+    def __init__(self, db: Database, port: int = 0, host: str = "127.0.0.1"):
+        self.db = db
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # silence default stderr spam
+                pass
+
+            def _json(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                if not n:
+                    return {}
+                try:
+                    return json.loads(self.rfile.read(n))
+                except json.JSONDecodeError:
+                    return {}
+
+            def do_GET(self):
+                outer._route(self, "GET")
+
+            def do_POST(self):
+                outer._route(self, "POST")
+
+            def do_PATCH(self):
+                outer._route(self, "PATCH")
+
+            def do_DELETE(self):
+                outer._route(self, "DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- routing
+
+    _ROUTES = [
+        ("GET", r"^/api/v1/ping$", "_ping"),
+        ("POST", r"^/api/v1/pipelines/validate$", "_validate"),
+        ("POST", r"^/api/v1/pipelines$", "_create_pipeline"),
+        ("GET", r"^/api/v1/pipelines$", "_list_pipelines"),
+        ("GET", r"^/api/v1/pipelines/([^/]+)$", "_get_pipeline"),
+        ("DELETE", r"^/api/v1/pipelines/([^/]+)$", "_delete_pipeline"),
+        ("GET", r"^/api/v1/pipelines/([^/]+)/jobs$", "_pipeline_jobs"),
+        ("GET", r"^/api/v1/jobs$", "_list_jobs"),
+        ("GET", r"^/api/v1/jobs/([^/]+)$", "_get_job"),
+        ("PATCH", r"^/api/v1/jobs/([^/]+)$", "_patch_job"),
+        ("GET", r"^/api/v1/jobs/([^/]+)/checkpoints$", "_job_checkpoints"),
+    ]
+
+    def _route(self, h, method: str) -> None:
+        path = h.path.split("?", 1)[0]
+        for m, pat, name in self._ROUTES:
+            if m != method:
+                continue
+            match = re.match(pat, path)
+            if match:
+                try:
+                    getattr(self, name)(h, *match.groups())
+                except Exception as e:  # noqa: BLE001
+                    h._json(500, {"error": str(e)})
+                return
+        h._json(404, {"error": f"no route {method} {path}"})
+
+    # ------------------------------------------------------------ handlers
+
+    def _ping(self, h):
+        h._json(200, {"pong": True})
+
+    def _validate(self, h):
+        from ..sql import plan_query
+        from ..sql.lexer import SqlError
+
+        body = h._body()
+        try:
+            plan_query(body.get("query", ""))
+            h._json(200, {"valid": True, "errors": []})
+        except SqlError as e:
+            h._json(200, {"valid": False, "errors": [str(e)]})
+
+    def _create_pipeline(self, h):
+        from ..sql import plan_query
+        from ..sql.lexer import SqlError
+
+        body = h._body()
+        name = body.get("name") or "pipeline"
+        query = body.get("query")
+        if not query:
+            h._json(400, {"error": "query is required"})
+            return
+        try:
+            plan_query(query)
+        except SqlError as e:
+            h._json(400, {"error": f"invalid query: {e}"})
+            return
+        parallelism = int(body.get("parallelism", 1))
+        pid = self.db.create_pipeline(name, query, parallelism)
+        jid = self.db.create_job(pid)
+        h._json(200, {"id": pid, "name": name, "job_id": jid})
+
+    def _list_pipelines(self, h):
+        h._json(200, {"data": self.db.list_pipelines()})
+
+    def _get_pipeline(self, h, pid):
+        p = self.db.get_pipeline(pid)
+        h._json(200, p) if p else h._json(404, {"error": "not found"})
+
+    def _delete_pipeline(self, h, pid):
+        for job in self.db.list_jobs(pid):
+            if job["state"] not in ("Failed", "Finished", "Stopped"):
+                h._json(409, {"error": "stop the pipeline's jobs first"})
+                return
+        self.db.delete_pipeline(pid)
+        h._json(200, {"deleted": pid})
+
+    def _pipeline_jobs(self, h, pid):
+        h._json(200, {"data": self.db.list_jobs(pid)})
+
+    def _list_jobs(self, h):
+        h._json(200, {"data": self.db.list_jobs()})
+
+    def _get_job(self, h, jid):
+        j = self.db.get_job(jid)
+        h._json(200, j) if j else h._json(404, {"error": "not found"})
+
+    def _patch_job(self, h, jid):
+        j = self.db.get_job(jid)
+        if not j:
+            h._json(404, {"error": "not found"})
+            return
+        body = h._body()
+        if body.get("action") == "restart":
+            self.db.update_job(jid, state="Restarting", desired_stop=None)
+            h._json(200, {"id": jid, "state": "Restarting"})
+            return
+        stop = body.get("stop")
+        if stop not in ("checkpoint", "immediate"):
+            h._json(400, {"error": "stop must be 'checkpoint' or 'immediate'"})
+            return
+        self.db.update_job(jid, desired_stop=stop)
+        h._json(200, {"id": jid, "desired_stop": stop})
+
+    def _job_checkpoints(self, h, jid):
+        h._json(200, {"data": self.db.list_checkpoints(jid)})
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="api-server"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,215 @@
+"""CLI: the single-binary entry point.
+
+Reference: crates/arroyo/src/main.rs:83-123 (clap subcommands run / api /
+cluster / worker / visualize). `python -m arroyo_tpu <cmd>`.
+
+  run <file.sql>      embedded cluster: api + controller + worker in-process,
+                      ^C checkpoints then stops (reference run.rs:84-118)
+  cluster             api + controller, jobs submitted over REST
+  api                 REST API only (external controller polls the same DB)
+  worker ...          subprocess entry used by the process scheduler
+  visualize <file.sql> print the dataflow graph as graphviz dot
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+
+def _cmd_visualize(args) -> int:
+    import arroyo_tpu
+    from arroyo_tpu.sql import plan_query
+
+    arroyo_tpu._load_operators()
+    with open(args.sql_file) as f:
+        pp = plan_query(f.read())
+    print(pp.graph.dot())
+    return 0
+
+
+def _cmd_run(args) -> int:
+    import arroyo_tpu
+    from arroyo_tpu.api import ApiServer
+    from arroyo_tpu.controller import ControllerServer, Database
+    from arroyo_tpu.controller.scheduler import scheduler_for
+
+    arroyo_tpu._load_operators()
+    with open(args.sql_file) as f:
+        sql = f.read()
+    db = Database(args.db or ":memory:")
+    api = ApiServer(db, port=args.api_port).start()
+    controller = ControllerServer(db, scheduler_for(args.scheduler)).start()
+    pid = db.create_pipeline(os.path.basename(args.sql_file), sql, args.parallelism)
+    jid = db.create_job(pid)
+    print(f"pipeline {pid} job {jid} (api on :{api.port})", file=sys.stderr)
+
+    stopping = threading.Event()
+
+    def on_sigint(_sig, _frm):
+        if stopping.is_set():
+            os._exit(130)
+        stopping.set()
+        print("stopping with a final checkpoint (^C again to force)", file=sys.stderr)
+        db.update_job(jid, desired_stop="checkpoint")
+
+    signal.signal(signal.SIGINT, on_sigint)
+    try:
+        state = controller.wait_for_state(
+            jid, "Finished", "Stopped", "Failed", timeout=args.timeout
+        )
+        print(f"job {jid}: {state}", file=sys.stderr)
+        return 0 if state in ("Finished", "Stopped") else 1
+    finally:
+        controller.stop()
+        api.stop()
+
+
+def _cmd_cluster(args) -> int:
+    import arroyo_tpu
+    from arroyo_tpu.api import ApiServer
+    from arroyo_tpu.controller import ControllerServer, Database
+    from arroyo_tpu.controller.scheduler import scheduler_for
+
+    arroyo_tpu._load_operators()
+    db = Database(args.db or ":memory:")
+    api = ApiServer(db, port=args.api_port).start()
+    controller = ControllerServer(db, scheduler_for(args.scheduler)).start()
+    print(f"cluster up: api on :{api.port}", file=sys.stderr)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        controller.stop()
+        api.stop()
+        return 0
+
+
+def _cmd_api(args) -> int:
+    from arroyo_tpu.api import ApiServer
+    from arroyo_tpu.controller import Database
+
+    db = Database(args.db or ":memory:")
+    api = ApiServer(db, port=args.api_port).start()
+    print(f"api on :{api.port}", file=sys.stderr)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        api.stop()
+        return 0
+
+
+def _cmd_worker(args) -> int:
+    """Worker subprocess (reference `arroyo worker` spawned by the process
+    scheduler): runs the engine, speaks the JSON-lines protocol on
+    stdin/stdout (scheduler.py docstring)."""
+    import arroyo_tpu
+    from arroyo_tpu.engine.engine import Engine
+    from arroyo_tpu.sql import plan_query
+    from arroyo_tpu.sql.planner import set_parallelism
+
+    arroyo_tpu._load_operators()
+
+    def emit(obj: dict) -> None:
+        sys.stdout.write(json.dumps(obj) + "\n")
+        sys.stdout.flush()
+
+    with open(args.sql_file) as f:
+        sql = f.read()
+    pp = plan_query(sql)
+    if args.parallelism > 1:
+        set_parallelism(pp.graph, args.parallelism)
+    eng = Engine(
+        pp.graph, job_id=args.job_id,
+        restore_epoch=args.restore_epoch,
+        storage_url=args.storage_url or None,
+    )
+    eng.start()
+    emit({"event": "started"})
+    reported: set[int] = set()
+
+    def read_commands() -> None:
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                cmd = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if cmd.get("cmd") == "checkpoint":
+                eng.trigger_checkpoint(int(cmd["epoch"]), then_stop=bool(cmd.get("then_stop")))
+            elif cmd.get("cmd") == "stop":
+                eng.stop()
+
+    threading.Thread(target=read_commands, daemon=True).start()
+    last_hb = 0.0
+    while True:
+        with eng._lock:
+            done = len(eng._finished_tasks) + len(eng._failed) >= eng._n_tasks
+            completed = sorted(eng._completed_epochs - reported)
+            failed = list(eng._failed)
+        for ep in completed:
+            reported.add(ep)
+            emit({"event": "checkpoint_completed", "epoch": ep})
+        if failed:
+            emit({"event": "failed", "error": failed[0].error or "task failed"})
+            return 1
+        if done:
+            emit({"event": "finished"})
+            return 0
+        if time.monotonic() - last_hb > 1.0:
+            emit({"event": "heartbeat"})
+            last_hb = time.monotonic()
+        time.sleep(0.05)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="arroyo_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    rp = sub.add_parser("run", help="run a SQL pipeline with an embedded cluster")
+    rp.add_argument("sql_file")
+    rp.add_argument("--parallelism", type=int, default=1)
+    rp.add_argument("--scheduler", default="embedded", choices=["embedded", "process"])
+    rp.add_argument("--api-port", type=int, default=0)
+    rp.add_argument("--db", default=None)
+    rp.add_argument("--timeout", type=float, default=86400)
+    rp.set_defaults(fn=_cmd_run)
+
+    cp = sub.add_parser("cluster", help="api + controller, submit jobs over REST")
+    cp.add_argument("--scheduler", default="process", choices=["embedded", "process"])
+    cp.add_argument("--api-port", type=int, default=5115)
+    cp.add_argument("--db", default=None)
+    cp.set_defaults(fn=_cmd_cluster)
+
+    ap = sub.add_parser("api", help="REST API server only")
+    ap.add_argument("--api-port", type=int, default=5115)
+    ap.add_argument("--db", default=None)
+    ap.set_defaults(fn=_cmd_api)
+
+    wp = sub.add_parser("worker", help="worker subprocess (used by process scheduler)")
+    wp.add_argument("--sql-file", required=True)
+    wp.add_argument("--job-id", required=True)
+    wp.add_argument("--parallelism", type=int, default=1)
+    wp.add_argument("--restore-epoch", type=int, default=None)
+    wp.add_argument("--storage-url", default=None)
+    wp.set_defaults(fn=_cmd_worker)
+
+    vp = sub.add_parser("visualize", help="print the dataflow graph as dot")
+    vp.add_argument("sql_file")
+    vp.set_defaults(fn=_cmd_visualize)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,259 @@
+"""Job supervision: the controller loop.
+
+Reference: ControllerServer (arroyo-controller/src/lib.rs:189) polling the DB
+for jobs (start_updater, lib.rs:543-567) and JobController
+(job_controller/mod.rs:555) driving heartbeat timeout checks, periodic
+checkpoints, failure detection, and the restart budget
+(pipeline.allowed-restarts, healthy-duration resets).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Optional
+
+from ..config import config
+from ..state.tables import latest_complete_checkpoint
+from .db import Database
+from .scheduler import Scheduler, WorkerHandle, scheduler_for
+from .states import JobState, check_transition
+
+
+class JobController:
+    """Supervises one job end-to-end (FSM + running-worker control)."""
+
+    def __init__(self, db: Database, job_id: str, scheduler: Scheduler,
+                 storage_url: Optional[str] = None):
+        self.db = db
+        self.job_id = job_id
+        self.scheduler = scheduler
+        self.storage_url = storage_url or config().get("checkpoint.storage-url")
+        self.state = JobState(self.db.get_job(job_id)["state"])
+        self.handle: Optional[WorkerHandle] = None
+        self.sql: Optional[str] = None
+        self.parallelism = 1
+        self.restarts = 0
+        self.restore_epoch: Optional[int] = None
+        self.next_epoch = 1
+        self.last_checkpoint_time = time.monotonic()
+        self.running_since: Optional[float] = None
+        self.stopping_epoch: Optional[int] = None
+        self.failure: Optional[str] = None
+
+    # ------------------------------------------------------------------
+
+    def _set_state(self, nxt: JobState, **fields) -> None:
+        check_transition(self.state, nxt)
+        self.state = nxt
+        self.db.update_job(self.job_id, state=nxt.value, **fields)
+
+    def is_terminal(self) -> bool:
+        return self.state in (JobState.FAILED, JobState.FINISHED, JobState.STOPPED)
+
+    # ------------------------------------------------------------------
+
+    def step(self) -> None:
+        """One supervision tick; cheap and non-blocking."""
+        try:
+            self._step_inner()
+        except Exception:  # noqa: BLE001 - job failure, not controller crash
+            self.failure = traceback.format_exc()
+            self._fail(self.failure)
+
+    def _fail(self, msg: str) -> None:
+        if self.handle:
+            self.handle.kill()
+            self.handle = None
+        if not self.is_terminal():
+            self._set_state(JobState.FAILED, failure_message=msg[-4000:])
+
+    def _step_inner(self) -> None:
+        job = self.db.get_job(self.job_id)
+        if job is None:
+            self._fail("job row deleted")
+            return
+        desired_stop = job["desired_stop"]
+
+        if self.state == JobState.CREATED:
+            self._set_state(JobState.COMPILING)
+        elif self.state == JobState.COMPILING:
+            self._compile(job)
+        elif self.state == JobState.SCHEDULING:
+            self._schedule(job)
+        elif self.state in (JobState.RUNNING, JobState.CHECKPOINT_STOPPING,
+                            JobState.STOPPING, JobState.FINISHING):
+            self._supervise(desired_stop)
+        elif self.state in (JobState.RECOVERING, JobState.RESTARTING, JobState.RESCALING):
+            restarts_allowed = config().get("pipeline.allowed-restarts")
+            if self.state == JobState.RECOVERING and self.restarts > restarts_allowed:
+                self._fail(f"exceeded allowed-restarts={restarts_allowed}: {self.failure}")
+                return
+            self.restore_epoch = latest_complete_checkpoint(self.storage_url, self.job_id)
+            self._set_state(JobState.SCHEDULING, restarts=self.restarts,
+                            restore_epoch=self.restore_epoch)
+
+    # ------------------------------------------------------------------
+
+    def _compile(self, job: dict) -> None:
+        from ..sql import plan_query
+
+        pipeline = self.db.get_pipeline(job["pipeline_id"])
+        if pipeline is None:
+            self._fail("pipeline deleted")
+            return
+        self.sql = pipeline["query"]
+        self.parallelism = int(pipeline["parallelism"])
+        plan_query(self.sql)  # validate; workers re-plan themselves
+        self._set_state(JobState.SCHEDULING)
+
+    def _schedule(self, job: dict) -> None:
+        if self.sql is None:
+            # a fresh JobController adopting a Restarting/Recovering job
+            # (reference: Restarting passes back through Compiling)
+            pipeline = self.db.get_pipeline(job["pipeline_id"])
+            if pipeline is None:
+                self._fail("pipeline deleted")
+                return
+            self.sql = pipeline["query"]
+            self.parallelism = int(pipeline["parallelism"])
+            self.restarts = int(job["restarts"])
+        self.handle = self.scheduler.start_worker(
+            self.sql, self.job_id, self.parallelism, self.restore_epoch,
+            self.storage_url,
+        )
+        self.running_since = time.monotonic()
+        self.last_checkpoint_time = time.monotonic()
+        if self.restore_epoch:
+            self.next_epoch = self.restore_epoch + 1
+        self._set_state(JobState.RUNNING)
+
+    def _supervise(self, desired_stop: Optional[str]) -> None:
+        assert self.handle is not None
+        cfgv = config()
+        # healthy-duration resets the restart budget (default.toml:8 analog)
+        healthy_ms = cfgv.get("pipeline.healthy-duration-ms")
+        if (self.restarts and self.running_since is not None
+                and (time.monotonic() - self.running_since) * 1000 >= healthy_ms):
+            self.restarts = 0
+            self.db.update_job(self.job_id, restarts=0)
+
+        for ev in self.handle.poll_events():
+            kind = ev.get("event")
+            if kind == "checkpoint_completed":
+                epoch = int(ev["epoch"])
+                self.db.record_checkpoint(self.job_id, epoch, "complete")
+                self.db.update_job(self.job_id, checkpoint_epoch=epoch)
+                if self.state == JobState.CHECKPOINT_STOPPING and epoch == self.stopping_epoch:
+                    self._set_state(JobState.STOPPING)
+            elif kind == "finished":
+                if self.state == JobState.STOPPING or self.state == JobState.CHECKPOINT_STOPPING:
+                    self._set_state(JobState.STOPPED)
+                else:
+                    self._set_state(JobState.FINISHING)
+                    self._set_state(JobState.FINISHED)
+                self.handle = None
+                return
+            elif kind == "failed":
+                self.failure = ev.get("error", "unknown worker failure")
+                self.handle.kill()
+                self.handle = None
+                self.restarts += 1
+                if self.state in (JobState.STOPPING, JobState.CHECKPOINT_STOPPING):
+                    self._set_state(JobState.STOPPED)
+                else:
+                    self._set_state(JobState.RECOVERING,
+                                    failure_message=self.failure[-4000:])
+                return
+
+        # heartbeat / liveness (reference worker-heartbeat-timeout)
+        hb_timeout = cfgv.get("pipeline.worker-heartbeat-timeout-ms") / 1000
+        if not self.handle.alive() or (
+            time.monotonic() - self.handle.last_heartbeat() > hb_timeout
+        ):
+            self.failure = "worker lost (heartbeat timeout)"
+            self.handle.kill()
+            self.handle = None
+            self.restarts += 1
+            self._set_state(JobState.RECOVERING, failure_message=self.failure)
+            return
+
+        # stop requests from the API
+        if self.state == JobState.RUNNING and desired_stop:
+            if desired_stop == "checkpoint":
+                self.stopping_epoch = self.next_epoch
+                self.next_epoch += 1
+                self.handle.trigger_checkpoint(self.stopping_epoch, then_stop=True)
+                self._set_state(JobState.CHECKPOINT_STOPPING)
+            else:
+                self.handle.stop()
+                self._set_state(JobState.STOPPING)
+            return
+
+        # periodic checkpoints (reference default-checkpoint-interval)
+        if self.state == JobState.RUNNING:
+            interval = cfgv.get("checkpoint.interval-ms") / 1000
+            if time.monotonic() - self.last_checkpoint_time >= interval:
+                self.handle.trigger_checkpoint(self.next_epoch)
+                self.next_epoch += 1
+                self.last_checkpoint_time = time.monotonic()
+
+
+class ControllerServer:
+    """Polls the DB and supervises every live job
+    (reference ControllerServer + start_updater)."""
+
+    def __init__(self, db: Database, scheduler: Optional[Scheduler] = None,
+                 storage_url: Optional[str] = None, poll_interval: float = 0.1):
+        self.db = db
+        self.scheduler = scheduler or scheduler_for(config().get("controller.scheduler"))
+        self.storage_url = storage_url
+        self.poll_interval = poll_interval
+        self.jobs: dict[str, JobController] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ControllerServer":
+        self._thread = threading.Thread(target=self._run, daemon=True, name="controller")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.tick()
+            self._stop.wait(self.poll_interval)
+
+    def tick(self) -> None:
+        for row in self.db.list_jobs():
+            jid = row["id"]
+            if jid not in self.jobs:
+                if row["state"] in ("Failed", "Finished", "Stopped"):
+                    continue
+                self.jobs[jid] = JobController(
+                    self.db, jid, self.scheduler, self.storage_url
+                )
+        for jid, jc in list(self.jobs.items()):
+            if jc.is_terminal():
+                del self.jobs[jid]
+                continue
+            jc.step()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        for jc in self.jobs.values():
+            if jc.handle:
+                jc.handle.kill()
+
+    def wait_for_state(self, job_id: str, *states: str, timeout: float = 120) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.db.get_job(job_id)
+            if job and job["state"] in states:
+                return job["state"]
+            if job and job["state"] == "Failed" and "Failed" not in states:
+                raise RuntimeError(f"job failed: {job['failure_message']}")
+            time.sleep(0.05)
+        raise TimeoutError(f"job {job_id} never reached {states}")

@@ -1,0 +1,151 @@
+"""Pipeline/job store.
+
+Equivalent of the reference's Postgres/SQLite DB shared by arroyo-api and
+arroyo-controller (cornucopia queries; controller polls it for desired-state
+changes, lib.rs:543-567). SQLite via the stdlib; one writer lock because the
+API server and controller share a process in the embedded deployment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS pipelines (
+    id TEXT PRIMARY KEY,
+    name TEXT NOT NULL,
+    query TEXT NOT NULL,
+    parallelism INTEGER NOT NULL DEFAULT 1,
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS jobs (
+    id TEXT PRIMARY KEY,
+    pipeline_id TEXT NOT NULL REFERENCES pipelines(id),
+    state TEXT NOT NULL,
+    desired_stop TEXT,            -- NULL | 'checkpoint' | 'immediate'
+    restarts INTEGER NOT NULL DEFAULT 0,
+    checkpoint_epoch INTEGER NOT NULL DEFAULT 0,
+    restore_epoch INTEGER,
+    failure_message TEXT,
+    run_id INTEGER NOT NULL DEFAULT 0,
+    updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS checkpoints (
+    job_id TEXT NOT NULL,
+    epoch INTEGER NOT NULL,
+    state TEXT NOT NULL,          -- 'inprogress' | 'complete' | 'compacted'
+    time REAL NOT NULL,
+    PRIMARY KEY (job_id, epoch)
+);
+"""
+
+
+class Database:
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._lock = threading.RLock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    # ------------------------------------------------------------ pipelines
+
+    def create_pipeline(self, name: str, query: str, parallelism: int = 1) -> str:
+        pid = f"pl_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO pipelines (id, name, query, parallelism, created_at) "
+                "VALUES (?,?,?,?,?)",
+                (pid, name, query, parallelism, time.time()),
+            )
+            self._conn.commit()
+        return pid
+
+    def get_pipeline(self, pid: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute("SELECT * FROM pipelines WHERE id=?", (pid,)).fetchone()
+        return dict(row) if row else None
+
+    def list_pipelines(self) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM pipelines ORDER BY created_at DESC"
+            ).fetchall()
+        return [dict(r) for r in rows]
+
+    def delete_pipeline(self, pid: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM jobs WHERE pipeline_id=?", (pid,))
+            self._conn.execute("DELETE FROM pipelines WHERE id=?", (pid,))
+            self._conn.commit()
+
+    # ----------------------------------------------------------------- jobs
+
+    def create_job(self, pipeline_id: str) -> str:
+        jid = f"job_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO jobs (id, pipeline_id, state, updated_at) VALUES (?,?,?,?)",
+                (jid, pipeline_id, "Created", time.time()),
+            )
+            self._conn.commit()
+        return jid
+
+    def get_job(self, jid: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute("SELECT * FROM jobs WHERE id=?", (jid,)).fetchone()
+        return dict(row) if row else None
+
+    def list_jobs(self, pipeline_id: Optional[str] = None) -> list[dict]:
+        with self._lock:
+            if pipeline_id:
+                rows = self._conn.execute(
+                    "SELECT * FROM jobs WHERE pipeline_id=? ORDER BY updated_at DESC",
+                    (pipeline_id,),
+                ).fetchall()
+            else:
+                rows = self._conn.execute(
+                    "SELECT * FROM jobs ORDER BY updated_at DESC"
+                ).fetchall()
+        return [dict(r) for r in rows]
+
+    def update_job(self, jid: str, **fields: Any) -> None:
+        if not fields:
+            return
+        cols = ", ".join(f"{k}=?" for k in fields)
+        with self._lock:
+            self._conn.execute(
+                f"UPDATE jobs SET {cols}, updated_at=? WHERE id=?",
+                (*fields.values(), time.time(), jid),
+            )
+            self._conn.commit()
+
+    # ---------------------------------------------------------- checkpoints
+
+    def record_checkpoint(self, job_id: str, epoch: int, state: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO checkpoints (job_id, epoch, state, time) VALUES (?,?,?,?) "
+                "ON CONFLICT(job_id, epoch) DO UPDATE SET state=excluded.state, time=excluded.time",
+                (job_id, epoch, state, time.time()),
+            )
+            self._conn.commit()
+
+    def list_checkpoints(self, job_id: str) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM checkpoints WHERE job_id=? ORDER BY epoch", (job_id,)
+            ).fetchall()
+        return [dict(r) for r in rows]
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

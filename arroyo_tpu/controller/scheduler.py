@@ -1,0 +1,231 @@
+"""Schedulers: how a job's dataflow gets executed.
+
+Reference: crates/arroyo-controller/src/schedulers/mod.rs:43-62 (trait
+Scheduler) with ProcessScheduler (spawn worker subprocesses) and
+EmbeddedScheduler (in-process tasks for `arroyo run`). The kubernetes and
+node schedulers of the reference map to the same WorkerHandle contract and
+are left to the deployment layer.
+
+Pipelines are defined by SQL text; workers re-plan locally, so no live
+expression objects cross the process boundary (the reference ships protobuf
+physical plans instead — same idea, the plan is data).
+
+Worker wire protocol (process scheduler), JSON lines:
+  worker -> controller (stdout): {"event": "started" | "heartbeat" |
+      "checkpoint_completed", "epoch": N} | {"event": "finished"} |
+      {"event": "failed", "error": "..."}
+  controller -> worker (stdin): {"cmd": "checkpoint", "epoch": N,
+      "then_stop": bool} | {"cmd": "stop"}
+This plays the role of the reference's ControllerGrpc/WorkerGrpc services
+(proto/rpc.proto:185-202, :397-410).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+
+class WorkerHandle:
+    """One running execution of a job's dataflow."""
+
+    def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        raise NotImplementedError
+
+    def poll_events(self) -> list[dict]:
+        raise NotImplementedError
+
+    def alive(self) -> bool:
+        raise NotImplementedError
+
+    def last_heartbeat(self) -> float:
+        raise NotImplementedError
+
+
+class EmbeddedWorkerHandle(WorkerHandle):
+    """Runs the Engine inside the controller process
+    (reference schedulers/embedded.rs)."""
+
+    def __init__(self, sql: str, job_id: str, parallelism: int,
+                 restore_epoch: Optional[int], storage_url: Optional[str] = None):
+        from ..engine.engine import Engine
+        from ..sql import plan_query
+        from ..sql.planner import set_parallelism
+
+        pp = plan_query(sql)
+        if parallelism > 1:
+            set_parallelism(pp.graph, parallelism)
+        self.engine = Engine(pp.graph, job_id=job_id, restore_epoch=restore_epoch,
+                             storage_url=storage_url)
+        self._events: "queue.Queue[dict]" = queue.Queue()
+        self._reported_epochs: set[int] = set()
+        self._done = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            self._events.put({"event": "started"})
+            self.engine.run_to_completion(timeout=None)
+            self._emit_epochs()
+            self._events.put({"event": "finished"})
+        except Exception as e:  # noqa: BLE001 - worker failure is data
+            self._emit_epochs()
+            self._events.put({"event": "failed", "error": str(e)})
+        finally:
+            self._done = True
+
+    def _emit_epochs(self) -> None:
+        for ep in sorted(self.engine._completed_epochs - self._reported_epochs):
+            self._reported_epochs.add(ep)
+            self._events.put({"event": "checkpoint_completed", "epoch": ep})
+
+    def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
+        self.engine.trigger_checkpoint(epoch, then_stop=then_stop)
+
+    def stop(self) -> None:
+        self.engine.stop()
+
+    def kill(self) -> None:
+        self.engine._abort()
+
+    def poll_events(self) -> list[dict]:
+        self._emit_epochs()
+        out = []
+        while True:
+            try:
+                out.append(self._events.get_nowait())
+            except queue.Empty:
+                return out
+
+    def alive(self) -> bool:
+        return not self._done
+
+    def last_heartbeat(self) -> float:
+        return time.monotonic()  # in-process: liveness == thread state
+
+
+class ProcessWorkerHandle(WorkerHandle):
+    """Spawns `python -m arroyo_tpu worker` (reference ProcessScheduler,
+    schedulers/mod.rs:72: spawns `arroyo worker` with env-injected config)."""
+
+    def __init__(self, sql: str, job_id: str, parallelism: int,
+                 restore_epoch: Optional[int], storage_url: Optional[str] = None):
+        import tempfile
+
+        self._sql_file = tempfile.NamedTemporaryFile(
+            "w", suffix=".sql", prefix=f"{job_id}-", delete=False
+        )
+        self._sql_file.write(sql)
+        self._sql_file.close()
+        cmd = [
+            sys.executable, "-m", "arroyo_tpu", "worker",
+            "--sql-file", self._sql_file.name,
+            "--job-id", job_id,
+            "--parallelism", str(parallelism),
+        ]
+        if restore_epoch is not None:
+            cmd += ["--restore-epoch", str(restore_epoch)]
+        if storage_url:
+            cmd += ["--storage-url", storage_url]
+        self.proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, bufsize=1,
+        )
+        self._events: "queue.Queue[dict]" = queue.Queue()
+        self._hb = time.monotonic()
+        self._reader = threading.Thread(target=self._read_stdout, daemon=True)
+        self._reader.start()
+
+    def _read_stdout(self) -> None:
+        assert self.proc.stdout is not None
+        for line in self.proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # worker debug output
+            self._hb = time.monotonic()
+            if ev.get("event") != "heartbeat":
+                self._events.put(ev)
+        rc = self.proc.wait()
+        if rc != 0:
+            err = self.proc.stderr.read() if self.proc.stderr else ""
+            self._events.put({"event": "failed", "error": f"worker exited {rc}: {err[-2000:]}"})
+
+    def _send(self, obj: dict) -> None:
+        if self.proc.stdin and self.proc.poll() is None:
+            try:
+                self.proc.stdin.write(json.dumps(obj) + "\n")
+                self.proc.stdin.flush()
+            except (BrokenPipeError, OSError):
+                pass
+
+    def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
+        self._send({"cmd": "checkpoint", "epoch": epoch, "then_stop": then_stop})
+
+    def stop(self) -> None:
+        self._send({"cmd": "stop"})
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+        try:
+            os.unlink(self._sql_file.name)
+        except OSError:
+            pass
+
+    def poll_events(self) -> list[dict]:
+        out = []
+        while True:
+            try:
+                out.append(self._events.get_nowait())
+            except queue.Empty:
+                return out
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None or not self._events.empty()
+
+    def last_heartbeat(self) -> float:
+        return self._hb
+
+
+class Scheduler:
+    """reference trait Scheduler (schedulers/mod.rs:43-62)."""
+
+    def start_worker(self, sql: str, job_id: str, parallelism: int,
+                     restore_epoch: Optional[int],
+                     storage_url: Optional[str] = None) -> WorkerHandle:
+        raise NotImplementedError
+
+
+class EmbeddedScheduler(Scheduler):
+    def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None):
+        return EmbeddedWorkerHandle(sql, job_id, parallelism, restore_epoch, storage_url)
+
+
+class ProcessScheduler(Scheduler):
+    def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None):
+        return ProcessWorkerHandle(sql, job_id, parallelism, restore_epoch, storage_url)
+
+
+def scheduler_for(name: str) -> Scheduler:
+    if name == "embedded":
+        return EmbeddedScheduler()
+    if name == "process":
+        return ProcessScheduler()
+    raise ValueError(f"unknown scheduler {name!r} (have: embedded, process)")

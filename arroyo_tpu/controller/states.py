@@ -1,0 +1,59 @@
+"""Job state machine.
+
+Mirrors the reference FSM (crates/arroyo-controller/src/states/mod.rs:47-228):
+Created -> Compiling -> Scheduling -> Running, with Recovering / Restarting /
+Rescaling / CheckpointStopping / Stopping and terminal Failed / Finished /
+Stopped. Transitions are validated so illegal jumps fail loudly.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class JobState(enum.Enum):
+    CREATED = "Created"
+    COMPILING = "Compiling"
+    SCHEDULING = "Scheduling"
+    RUNNING = "Running"
+    RECOVERING = "Recovering"
+    RESTARTING = "Restarting"
+    RESCALING = "Rescaling"
+    CHECKPOINT_STOPPING = "CheckpointStopping"
+    STOPPING = "Stopping"
+    FINISHING = "Finishing"
+    FAILED = "Failed"
+    FINISHED = "Finished"
+    STOPPED = "Stopped"
+
+
+TERMINAL = {JobState.FAILED, JobState.FINISHED, JobState.STOPPED}
+
+# legal transitions (reference states/mod.rs transition table)
+TRANSITIONS: dict[JobState, set[JobState]] = {
+    JobState.CREATED: {JobState.COMPILING, JobState.FAILED, JobState.STOPPED},
+    JobState.COMPILING: {JobState.SCHEDULING, JobState.FAILED, JobState.STOPPED},
+    JobState.SCHEDULING: {JobState.RUNNING, JobState.FAILED, JobState.STOPPED,
+                          JobState.RECOVERING},
+    JobState.RUNNING: {JobState.RECOVERING, JobState.RESTARTING, JobState.RESCALING,
+                       JobState.CHECKPOINT_STOPPING, JobState.STOPPING,
+                       JobState.FINISHING, JobState.FINISHED, JobState.FAILED},
+    JobState.RECOVERING: {JobState.SCHEDULING, JobState.FAILED, JobState.STOPPED},
+    JobState.RESTARTING: {JobState.SCHEDULING, JobState.FAILED, JobState.STOPPED},
+    JobState.RESCALING: {JobState.SCHEDULING, JobState.FAILED, JobState.STOPPED},
+    JobState.CHECKPOINT_STOPPING: {JobState.STOPPING, JobState.STOPPED, JobState.FAILED},
+    JobState.STOPPING: {JobState.STOPPED, JobState.FAILED},
+    JobState.FINISHING: {JobState.FINISHED, JobState.FAILED},
+    JobState.FAILED: {JobState.RESTARTING},  # manual restart of a failed job
+    JobState.FINISHED: set(),
+    JobState.STOPPED: {JobState.RESTARTING},
+}
+
+
+class IllegalTransition(RuntimeError):
+    pass
+
+
+def check_transition(cur: JobState, nxt: JobState) -> None:
+    if nxt not in TRANSITIONS[cur]:
+        raise IllegalTransition(f"job cannot go {cur.value} -> {nxt.value}")

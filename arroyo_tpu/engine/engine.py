@@ -28,6 +28,8 @@ from ..operators.base import Operator, OperatorContext, SourceOperator
 from ..operators.collector import Collector, OutEdge
 from ..state.tables import (
     TableManager,
+    cleanup_checkpoints,
+    compact_job,
     latest_complete_checkpoint,
     write_job_checkpoint_metadata,
 )
@@ -233,6 +235,29 @@ class Engine:
                     return False
                 self._cond.wait(timeout=min(remaining, 0.5))
         return True
+
+    def compact(self, epoch: int) -> int:
+        """Merge the epoch's per-subtask state shards (reference: controller
+        compact_state trigger, job_controller/mod.rs:382). Safe only for
+        completed epochs."""
+        with self._lock:
+            if epoch not in self._completed_epochs:
+                raise ValueError(f"epoch {epoch} is not a completed checkpoint")
+        return compact_job(self.storage_url, self.job_id, epoch)
+
+    def cleanup(self, min_epoch: int) -> int:
+        """Drop checkpoints below min_epoch (controller epoch GC). Refuses
+        to delete past the newest restorable checkpoint."""
+        with self._lock:
+            newest = max(self._completed_epochs, default=None)
+        if newest is None:
+            newest = latest_complete_checkpoint(self.storage_url, self.job_id)
+        if newest is None or min_epoch > newest:
+            raise ValueError(
+                f"cleanup(min_epoch={min_epoch}) would delete every restorable "
+                f"checkpoint (newest complete epoch: {newest})"
+            )
+        return cleanup_checkpoints(self.storage_url, self.job_id, min_epoch)
 
     def stop(self) -> None:
         for t in self.source_tasks():

@@ -346,6 +346,23 @@ class Func(Expr):
             return _null_mask(_as_obj(a[0], n) if _is_str(a[0]) else np.broadcast_to(np.asarray(a[0]), (n,)))
         if name == "is_not_null":
             return ~_null_mask(_as_obj(a[0], n) if _is_str(a[0]) else np.broadcast_to(np.asarray(a[0]), (n,)))
+        if name == "like":
+            import re as _re
+
+            pat = a[1] if isinstance(a[1], str) else str(a[1])
+            # SQL LIKE: % = any run, _ = one char; everything else literal
+            rx = _re.compile(
+                "^" + "".join(
+                    ".*" if c == "%" else "." if c == "_" else _re.escape(c)
+                    for c in pat
+                ) + "$",
+                _re.DOTALL,
+            )
+            vals = _as_obj(a[0], n)
+            return np.array(
+                [bool(rx.match(s)) if s is not None else False for s in vals],
+                dtype=bool,
+            )
         raise NotImplementedError(f"scalar function {name}")
 
     def eval_jnp(self, cols):

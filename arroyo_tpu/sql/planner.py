@@ -356,8 +356,14 @@ class Planner:
     def plan_select(self, q: Select) -> Rel:
         if q.union:
             return self._plan_union(q)
-        if q.order_by and q.limit is None:
+        if q.order_by:
             raise PlanError("ORDER BY is only supported inside OVER(...) windows")
+        if q.limit is not None:
+            raise PlanError("LIMIT is unsupported on streaming queries")
+        if q.distinct:
+            raise PlanError(
+                "SELECT DISTINCT is unsupported; GROUP BY the columns instead"
+            )
         if q.from_table is None:
             raise PlanError("SELECT without FROM is unsupported")
         rel = self._plan_table_ref(q.from_table)

@@ -342,6 +342,126 @@ class TableManager:
         return restored_wm
 
 
+def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
+    """Merge an operator's per-subtask state files into one file per table
+    (reference: ParquetBackend::compact_operator, arroyo-state/src/parquet.rs:159
+    — merges small files across checkpoints and bumps the generation).
+
+    Snapshots here are self-contained per epoch, so compaction merges the
+    per-subtask shards of one epoch. The merged file (generation 1) is
+    assigned to subtask 0's metadata; other subtasks' file lists are
+    cleared (their watermarks are preserved), so a later restore reads the
+    data exactly once and re-shards it by routing-key range.
+    Returns the number of files merged away.
+    """
+    opdir = operator_dir(storage_url, job_id, epoch, node_id)
+    if not os.path.isdir(opdir):
+        return 0
+    metas = []
+    for fn in sorted(os.listdir(opdir)):
+        if fn.startswith("metadata-") and fn.endswith(".json"):
+            with open(os.path.join(opdir, fn)) as f:
+                metas.append((fn, json.load(f)))
+    by_table: dict[str, list[dict]] = {}
+    for _fn, m in metas:
+        for fmeta in m["files"]:
+            if int(fmeta.get("generation", 0)) == 0:
+                by_table.setdefault(fmeta["table"], []).append(fmeta)
+    merged_files: dict[str, dict] = {}
+    removed = 0
+    ext = "parquet" if _checkpoint_format() == "parquet" else "npz"
+    for tname, fmetas in by_table.items():
+        if len(fmetas) < 2:
+            continue
+        kind = fmetas[0]["kind"]
+        out_name = f"table-{tname}-compacted-g1.{'bin' if kind == 'global_keyed' else ext}"
+        out_path = os.path.join(opdir, out_name)
+        if kind == "global_keyed":
+            data: dict = {}
+            for fm in fmetas:
+                with open(os.path.join(opdir, fm["file"]), "rb") as f:
+                    data.update(pickle.load(f))
+            with open(out_path, "wb") as f:
+                pickle.dump(data, f)
+            merged = dict(fmetas[0])
+        else:
+            col_parts = [read_columnar(os.path.join(opdir, fm["file"])) for fm in fmetas]
+            names = col_parts[0].keys()
+            cols = {n: np.concatenate([p[n] for p in col_parts]) for n in names}
+            write_columnar(out_path, cols)
+            merged = dict(fmetas[0])
+            merged["min_timestamp"] = min(fm["min_timestamp"] for fm in fmetas)
+            merged["max_timestamp"] = max(fm["max_timestamp"] for fm in fmetas)
+            if all("min_key" in fm for fm in fmetas):
+                merged["min_key"] = min(fm["min_key"] for fm in fmetas)
+                merged["max_key"] = max(fm["max_key"] for fm in fmetas)
+        merged["file"] = out_name
+        merged["generation"] = 1
+        merged_files[tname] = merged
+    if not merged_files:
+        return 0
+    # crash safety: merged files and rewritten metadata land BEFORE the old
+    # shards are deleted — an interruption leaves a restorable epoch either
+    # way (at worst both copies exist; gen-0 entries were already dropped
+    # from metadata so nothing is read twice)
+    for fn, m in metas:
+        kept = [
+            fm for fm in m["files"]
+            if fm["table"] not in merged_files or int(fm.get("generation", 0)) > 0
+        ]
+        if m["subtask_index"] == min(mm["subtask_index"] for _f, mm in metas):
+            kept.extend(merged_files.values())
+        m["files"] = kept
+        tmp = os.path.join(opdir, fn + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(m, f)
+        os.replace(tmp, os.path.join(opdir, fn))
+    for fmetas in by_table.values():
+        if len(fmetas) < 2:
+            continue
+        for fm in fmetas:
+            try:
+                os.remove(os.path.join(opdir, fm["file"]))
+                removed += 1
+            except FileNotFoundError:
+                pass
+    return removed
+
+
+def compact_job(storage_url: str, job_id: str, epoch) -> int:
+    """Compact every operator of one completed checkpoint."""
+    cdir = checkpoint_dir(storage_url, job_id, epoch)
+    total = 0
+    if not os.path.isdir(cdir):
+        return 0
+    for fn in sorted(os.listdir(cdir)):
+        if fn.startswith("operator-"):
+            total += compact_operator(storage_url, job_id, epoch, fn[len("operator-"):])
+    return total
+
+
+def cleanup_checkpoints(storage_url: str, job_id: str, min_epoch: int) -> int:
+    """Delete checkpoints below ``min_epoch`` (reference
+    parquet.rs:214 cleanup_operator + controller epoch GC). The "final"
+    drained-source snapshot is always kept. Returns dirs removed."""
+    import shutil
+
+    base = os.path.join(storage_url, job_id, "checkpoints")
+    if not os.path.isdir(base):
+        return 0
+    removed = 0
+    for fn in sorted(os.listdir(base)):
+        if not fn.startswith("checkpoint-"):
+            continue
+        tag = fn.split("-", 1)[1]
+        if not tag.isdigit():
+            continue  # "final" and friends
+        if int(tag) < min_epoch:
+            shutil.rmtree(os.path.join(base, fn), ignore_errors=True)
+            removed += 1
+    return removed
+
+
 def write_job_checkpoint_metadata(
     storage_url: str, job_id: str, epoch: int, extra: Optional[dict] = None
 ) -> str:

@@ -1,0 +1,176 @@
+"""Control plane: FSM, schedulers, REST API, recovery.
+
+Covers reference behaviors: job FSM transitions (states/mod.rs), embedded
+scheduler runs (schedulers/embedded.rs), process-scheduler worker spawning +
+crash recovery with restart budget (job_controller/mod.rs:504-530), stop with
+final checkpoint + restart from it (states/scheduling.rs restore path), and
+the REST resource model (arroyo-api/src/rest.rs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from arroyo_tpu.controller import ControllerServer, Database, JobState
+from arroyo_tpu.controller.scheduler import EmbeddedScheduler, ProcessScheduler
+from arroyo_tpu.controller.states import IllegalTransition, check_transition
+
+
+SMOKE = os.path.join(os.path.dirname(__file__), "smoke")
+
+
+def _sql(tmp_path, name="grouped_aggregates"):
+    with open(os.path.join(SMOKE, "queries", f"{name}.sql")) as f:
+        sql = f.read()
+    out = str(tmp_path / "out.json")
+    return sql.replace("$input_dir", os.path.join(SMOKE, "inputs")).replace(
+        "$output_path", out
+    ), out
+
+
+def _assert_golden(out, name="grouped_aggregates"):
+    import glob
+
+    got = []
+    for p in sorted(glob.glob(out) + glob.glob(out + ".*")):
+        with open(p) as f:
+            got.extend(json.loads(l) for l in f if l.strip())
+    with open(os.path.join(SMOKE, "golden", f"{name}.json")) as f:
+        want = [json.loads(l) for l in f if l.strip()]
+    key = lambda r: json.dumps(r, sort_keys=True)
+    assert sorted(map(key, got)) == sorted(map(key, want))
+
+
+def test_fsm_transitions():
+    check_transition(JobState.CREATED, JobState.COMPILING)
+    check_transition(JobState.RUNNING, JobState.RECOVERING)
+    check_transition(JobState.CHECKPOINT_STOPPING, JobState.STOPPING)
+    with pytest.raises(IllegalTransition):
+        check_transition(JobState.CREATED, JobState.RUNNING)
+    with pytest.raises(IllegalTransition):
+        check_transition(JobState.FINISHED, JobState.RUNNING)
+
+
+def test_embedded_job_to_finished(tmp_path, _storage):
+    sql, out = _sql(tmp_path)
+    db = Database()
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+    try:
+        pid = db.create_pipeline("agg", sql, 1)
+        jid = db.create_job(pid)
+        state = ctl.wait_for_state(jid, "Finished", timeout=120)
+        assert state == "Finished"
+        _assert_golden(out)
+    finally:
+        ctl.stop()
+
+
+def test_stop_with_checkpoint_and_restart(tmp_path, _storage):
+    from arroyo_tpu import config as cfg
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    cfg.update({"testing.source-read-delay-micros": 4000,
+                "checkpoint.interval-ms": 150})
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+    try:
+        pid = db.create_pipeline("agg", sql, 2)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        time.sleep(0.4)  # let at least one periodic checkpoint land
+        db.update_job(jid, desired_stop="checkpoint")
+        state = ctl.wait_for_state(jid, "Stopped", timeout=60)
+        assert state == "Stopped"
+        epochs = [c for c in db.list_checkpoints(jid) if c["state"] == "complete"]
+        assert epochs, "stop-with-checkpoint must record a completed epoch"
+        # restart: resumes from the stop checkpoint and finishes
+        cfg.update({"testing.source-read-delay-micros": 0})
+        db.update_job(jid, desired_stop=None, state="Restarting")
+        state = ctl.wait_for_state(jid, "Finished", timeout=120)
+        assert state == "Finished"
+        _assert_golden(out)
+    finally:
+        cfg.update({"testing.source-read-delay-micros": 0,
+                    "checkpoint.interval-ms": 10_000})
+        ctl.stop()
+
+
+def test_process_scheduler_crash_recovery(tmp_path, _storage):
+    """Kill the worker mid-run; controller must restore from the last
+    checkpoint and produce exactly-once output."""
+    from arroyo_tpu import config as cfg
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    # subprocess workers read config from the environment
+    os.environ["ARROYO_TPU__TESTING__SOURCE_READ_DELAY_MICROS"] = "3000"
+    os.environ["ARROYO_TPU__CHECKPOINT__STORAGE_URL"] = cfg.config().get(
+        "checkpoint.storage-url"
+    )
+    cfg.update({"checkpoint.interval-ms": 150})
+    ctl = ControllerServer(db, ProcessScheduler()).start()
+    try:
+        pid = db.create_pipeline("agg", sql, 2)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        # wait for a completed checkpoint, then kill the worker process
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(c["state"] == "complete" for c in db.list_checkpoints(jid)):
+                break
+            time.sleep(0.05)
+        jc = ctl.jobs[jid]
+        assert jc.handle is not None
+        jc.handle.proc.kill()
+        os.environ["ARROYO_TPU__TESTING__SOURCE_READ_DELAY_MICROS"] = "0"
+        state = ctl.wait_for_state(jid, "Finished", timeout=120)
+        assert state == "Finished"
+        job = db.get_job(jid)
+        assert job["restarts"] >= 1
+        _assert_golden(out)
+    finally:
+        os.environ.pop("ARROYO_TPU__TESTING__SOURCE_READ_DELAY_MICROS", None)
+        os.environ.pop("ARROYO_TPU__CHECKPOINT__STORAGE_URL", None)
+        cfg.update({"checkpoint.interval-ms": 10_000})
+        ctl.stop()
+
+
+def test_rest_api_lifecycle(tmp_path, _storage):
+    from arroyo_tpu.api import ApiServer
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    api = ApiServer(db, port=0).start()
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+
+    def req(method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{api.port}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(r) as resp:
+            return json.loads(resp.read())
+
+    try:
+        assert req("GET", "/api/v1/ping")["pong"]
+        bad = req("POST", "/api/v1/pipelines/validate", {"query": "SELEC nope"})
+        assert not bad["valid"] and bad["errors"]
+        ok = req("POST", "/api/v1/pipelines/validate", {"query": sql})
+        assert ok["valid"]
+        created = req("POST", "/api/v1/pipelines", {"name": "agg", "query": sql})
+        jid = created["job_id"]
+        assert any(p["id"] == created["id"] for p in req("GET", "/api/v1/pipelines")["data"])
+        state = ctl.wait_for_state(jid, "Finished", timeout=120)
+        assert state == "Finished"
+        assert req("GET", f"/api/v1/jobs/{jid}")["state"] == "Finished"
+        _assert_golden(out)
+        assert req("DELETE", f"/api/v1/pipelines/{created['id']}")["deleted"]
+    finally:
+        ctl.stop()
+        api.stop()

@@ -157,6 +157,9 @@ def test_smoke(name, tmp_path, _storage):
             if not eng2.checkpoint_and_wait(epoch, timeout=60):
                 stopped_mid_stream = False  # pipeline drained before epoch
                 break
+            if epoch == 2:
+                # reference runs state compaction after epoch 2
+                eng2.compact(2)
         if stopped_mid_stream:
             time.sleep(0.05)
             stopped_mid_stream = eng2.checkpoint_and_wait(3, timeout=60, then_stop=True)
@@ -166,6 +169,10 @@ def test_smoke(name, tmp_path, _storage):
 
     # ---- run 3: restore from epoch 3 at parallelism 3, finish ---------
     if stopped_mid_stream:
+        # compact the restore epoch + GC older epochs first: restore must
+        # work from compacted generation-1 files alone
+        eng2.compact(3)
+        eng2.cleanup(min_epoch=3)
         eng3 = build(sql2, 3, f"{name}-ckpt", restore_epoch=3)
         eng3.run_to_completion(timeout=180)
     assert_outputs(name, out2)
