@@ -21,6 +21,10 @@ from .types import (  # noqa: F401
 
 def _load_operators() -> None:
     """Import all operator/connector modules so constructors register."""
+    from .utils import ensure_parquet_initialized
+
+    ensure_parquet_initialized()  # see utils/arrow.py: must happen before
+    # any engine task thread touches parquet
     from . import connectors
     from .operators import builtin  # noqa: F401
 

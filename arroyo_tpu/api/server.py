@@ -87,6 +87,8 @@ class ApiServer:
         ("GET", r"^/api/v1/jobs/([^/]+)$", "_get_job"),
         ("PATCH", r"^/api/v1/jobs/([^/]+)$", "_patch_job"),
         ("GET", r"^/api/v1/jobs/([^/]+)/checkpoints$", "_job_checkpoints"),
+        ("GET", r"^/api/v1/jobs/([^/]+)/output$", "_job_output"),
+        ("GET", r"^/api/v1/connectors$", "_connectors"),
     ]
 
     def _route(self, h, method: str) -> None:
@@ -183,6 +185,21 @@ class ApiServer:
 
     def _job_checkpoints(self, h, jid):
         h._json(200, {"data": self.db.list_checkpoints(jid)})
+
+    def _job_output(self, h, jid):
+        # ?after=<seq> for incremental tailing (reference SubscribeToOutput)
+        after = -1
+        if "?" in h.path:
+            from urllib.parse import parse_qs
+
+            q = parse_qs(h.path.split("?", 1)[1])
+            after = int(q.get("after", ["-1"])[0])
+        h._json(200, {"data": self.db.list_outputs(jid, after_seq=after)})
+
+    def _connectors(self, h):
+        from ..connectors import connectors
+
+        h._json(200, connectors())
 
     # ------------------------------------------------------------ lifecycle
 

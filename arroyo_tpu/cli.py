@@ -150,6 +150,8 @@ def _cmd_worker(args) -> int:
                 eng.stop()
 
     threading.Thread(target=read_commands, daemon=True).start()
+    from arroyo_tpu.connectors.preview import take_preview_rows
+
     last_hb = 0.0
     while True:
         with eng._lock:
@@ -159,6 +161,9 @@ def _cmd_worker(args) -> int:
         for ep in completed:
             reported.add(ep)
             emit({"event": "checkpoint_completed", "epoch": ep})
+        lines = take_preview_rows(args.job_id)
+        if lines:
+            emit({"event": "sink_data", "lines": lines})
         if failed:
             emit({"event": "failed", "error": failed[0].error or "task failed"})
             return 1

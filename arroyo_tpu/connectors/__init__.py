@@ -50,6 +50,8 @@ def _make_sink(cfg: dict):
 def load_all() -> None:
     from . import blackhole, impulse, single_file, stdout, vec  # noqa: F401
     from . import nexmark  # noqa: F401
+    from . import filesystem, http_conn, kafka, preview, redis  # noqa: F401
+    from . import stubs, websocket  # noqa: F401
 
 
 def connectors() -> dict:

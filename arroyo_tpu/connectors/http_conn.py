@@ -1,0 +1,230 @@
+"""HTTP-family connectors: SSE source, polling source, webhook sink.
+
+Reference: crates/arroyo-connectors/src/{sse,polling_http,webhook} — all
+stdlib-implementable (http.client / urllib), no gating needed.
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.request
+from typing import Optional
+from urllib.parse import urlparse
+
+from ..batch import Schema
+from ..config import config
+from ..operators.base import Operator, SourceOperator, TableSpec
+from ..types import SourceFinishType
+from . import register_sink, register_source
+
+
+def _parse_headers(cfg: dict) -> dict[str, str]:
+    out = {}
+    raw = cfg.get("headers")
+    if isinstance(raw, dict):
+        return {str(k): str(v) for k, v in raw.items()}
+    if raw:
+        for part in str(raw).split(","):
+            if ":" in part:
+                k, v = part.split(":", 1)
+                out[k.strip()] = v.strip()
+    return out
+
+
+class SSESource(SourceOperator):
+    """Server-sent events (reference sse connector, eventsource protocol).
+    config: endpoint, events (comma-separated filter), headers, schema +
+    format options. State: Last-Event-ID for resumption."""
+
+    def __init__(self, cfg: dict):
+        self.cfg = cfg
+        self.schema: Schema = cfg["schema"]
+        self.endpoint = str(cfg["endpoint"])
+        self.event_filter = {
+            e.strip() for e in str(cfg.get("events", "")).split(",") if e.strip()
+        } or None
+        self.headers = _parse_headers(cfg)
+
+    def tables(self):
+        return [TableSpec("e", "global_keyed")]
+
+    def run(self, sctx, collector) -> SourceFinishType:
+        import http.client
+
+        from ..formats.registry import make_deserializer
+
+        ctx = sctx.ctx
+        if ctx.task_info.subtask_index != 0:
+            return SourceFinishType.GRACEFUL
+        tbl = ctx.table_manager.global_keyed("e")
+        last_id = tbl.get("last_event_id")
+        url = urlparse(self.endpoint)
+        conn_cls = http.client.HTTPSConnection if url.scheme == "https" else http.client.HTTPConnection
+        conn = conn_cls(url.netloc, timeout=10)
+        headers = {"Accept": "text/event-stream", **self.headers}
+        if last_id:
+            headers["Last-Event-ID"] = last_id
+        path = url.path + (f"?{url.query}" if url.query else "")
+        conn.request("GET", path or "/", headers=headers)
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(f"SSE endpoint returned {resp.status}")
+        de = make_deserializer(self.cfg, self.schema)
+        resp.fp.raw._sock.settimeout(0.2)  # poll control between reads
+
+        data_lines: list[str] = []
+        event_type = "message"
+        while True:
+            msg = sctx.poll_control()
+            if msg is not None:
+                if msg.kind == "checkpoint":
+                    b = de.flush()
+                    if b is not None:
+                        collector.collect(b)
+                    if last_id is not None:
+                        tbl.insert("last_event_id", last_id)
+                    sctx.start_checkpoint(msg.barrier)
+                    if msg.barrier.then_stop:
+                        return SourceFinishType.FINAL
+                elif msg.kind == "stop":
+                    return SourceFinishType.IMMEDIATE
+            try:
+                raw = resp.fp.readline()
+            except TimeoutError:
+                if de.should_flush():
+                    b = de.flush()
+                    if b is not None:
+                        collector.collect(b)
+                continue
+            except OSError:
+                continue
+            if not raw:
+                b = de.flush()
+                if b is not None:
+                    collector.collect(b)
+                return SourceFinishType.GRACEFUL  # stream closed
+            line = raw.decode("utf-8").rstrip("\n").rstrip("\r")
+            if not line:  # dispatch event
+                if data_lines and (self.event_filter is None or event_type in self.event_filter):
+                    de.deserialize(
+                        "\n".join(data_lines),
+                        timestamp_micros=int(time.time() * 1e6),
+                    )
+                    if de.should_flush():
+                        b = de.flush()
+                        if b is not None:
+                            collector.collect(b)
+                data_lines = []
+                event_type = "message"
+                continue
+            if line.startswith(":"):
+                continue
+            field, _, value = line.partition(":")
+            value = value.lstrip(" ")
+            if field == "data":
+                data_lines.append(value)
+            elif field == "event":
+                event_type = value
+            elif field == "id":
+                last_id = value
+
+
+class PollingHTTPSource(SourceOperator):
+    """config: endpoint, poll_interval_ms (default 1000), emit_behavior:
+    'all' | 'changed' (dedupe identical bodies), method, body, headers,
+    framing, schema + format options (reference polling_http connector)."""
+
+    def __init__(self, cfg: dict):
+        self.cfg = cfg
+        self.schema: Schema = cfg["schema"]
+        self.endpoint = str(cfg["endpoint"])
+        self.interval_s = int(cfg.get("poll_interval_ms", 1000)) / 1000
+        self.emit_behavior = str(cfg.get("emit_behavior", "all"))
+        self.method = str(cfg.get("method", "GET"))
+        self.body = cfg.get("body")
+        self.headers = _parse_headers(cfg)
+        self.max_polls = cfg.get("testing.max_polls")  # deterministic tests
+
+    def tables(self):
+        return [TableSpec("h", "global_keyed")]
+
+    def run(self, sctx, collector) -> SourceFinishType:
+        from ..formats.framing import frame_iter
+        from ..formats.registry import default_framing, make_deserializer
+
+        ctx = sctx.ctx
+        if ctx.task_info.subtask_index != 0:
+            return SourceFinishType.GRACEFUL
+        de = make_deserializer(self.cfg, self.schema)
+        framing = default_framing(self.cfg) or "newline"
+        last_body: Optional[bytes] = None
+        polls = 0
+        next_poll = time.monotonic()
+        while True:
+            msg = sctx.poll_control()
+            if msg is not None:
+                if msg.kind == "checkpoint":
+                    b = de.flush()
+                    if b is not None:
+                        collector.collect(b)
+                    sctx.start_checkpoint(msg.barrier)
+                    if msg.barrier.then_stop:
+                        return SourceFinishType.FINAL
+                elif msg.kind == "stop":
+                    return SourceFinishType.IMMEDIATE
+            now = time.monotonic()
+            if now < next_poll:
+                time.sleep(min(next_poll - now, 0.05))
+                continue
+            next_poll = now + self.interval_s
+            req = urllib.request.Request(
+                self.endpoint, method=self.method,
+                data=self.body.encode() if self.body else None,
+                headers=self.headers,
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    body = resp.read()
+            except Exception:
+                if str(self.cfg.get("bad_data", "fail")) == "drop":
+                    continue
+                raise
+            if self.emit_behavior == "changed" and body == last_body:
+                continue
+            last_body = body
+            ts = int(time.time() * 1e6)
+            for frame in frame_iter(body, framing):
+                de.deserialize(frame, timestamp_micros=ts)
+            b = de.flush()
+            if b is not None:
+                collector.collect(b)
+            polls += 1
+            if self.max_polls is not None and polls >= int(self.max_polls):
+                return SourceFinishType.GRACEFUL
+
+
+class WebhookSink(Operator):
+    """config: endpoint, headers, format options — POSTs each serialized
+    message (reference webhook connector)."""
+
+    def __init__(self, cfg: dict):
+        self.cfg = cfg
+        self.endpoint = str(cfg["endpoint"])
+        self.headers = _parse_headers(cfg)
+        self.schema = cfg.get("schema")
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        from ..formats.registry import serialize_batch
+
+        for payload in serialize_batch(self.cfg, batch, self.schema):
+            req = urllib.request.Request(
+                self.endpoint, data=payload, method="POST",
+                headers={"Content-Type": "application/json", **self.headers},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+
+
+register_source("sse")(SSESource)
+register_source("polling_http")(PollingHTTPSource)
+register_sink("webhook")(WebhookSink)

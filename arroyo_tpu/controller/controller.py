@@ -141,7 +141,9 @@ class JobController:
 
         for ev in self.handle.poll_events():
             kind = ev.get("event")
-            if kind == "checkpoint_completed":
+            if kind == "sink_data":
+                self.db.record_output(self.job_id, ev.get("lines", []))
+            elif kind == "checkpoint_completed":
                 epoch = int(ev["epoch"])
                 self.db.record_checkpoint(self.job_id, epoch, "complete")
                 self.db.update_job(self.job_id, checkpoint_epoch=epoch)

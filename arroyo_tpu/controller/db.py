@@ -43,7 +43,15 @@ CREATE TABLE IF NOT EXISTS checkpoints (
     time REAL NOT NULL,
     PRIMARY KEY (job_id, epoch)
 );
+CREATE TABLE IF NOT EXISTS job_outputs (
+    job_id TEXT NOT NULL,
+    seq INTEGER NOT NULL,
+    line TEXT NOT NULL,
+    PRIMARY KEY (job_id, seq)
+);
 """
+
+_OUTPUT_CAP = 10_000  # preview rows retained per job
 
 
 class Database:
@@ -143,6 +151,38 @@ class Database:
         with self._lock:
             rows = self._conn.execute(
                 "SELECT * FROM checkpoints WHERE job_id=? ORDER BY epoch", (job_id,)
+            ).fetchall()
+        return [dict(r) for r in rows]
+
+    # -------------------------------------------------- preview output
+
+    def record_output(self, job_id: str, lines: list[str]) -> None:
+        """Append preview sink rows (reference: SendSinkData gRPC rows
+        buffered controller-side for the UI), bounded per job."""
+        if not lines:
+            return
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT COALESCE(MAX(seq), -1) AS m FROM job_outputs WHERE job_id=?",
+                (job_id,),
+            ).fetchone()
+            seq = int(row["m"]) + 1
+            self._conn.executemany(
+                "INSERT INTO job_outputs (job_id, seq, line) VALUES (?,?,?)",
+                [(job_id, seq + i, l) for i, l in enumerate(lines)],
+            )
+            self._conn.execute(
+                "DELETE FROM job_outputs WHERE job_id=? AND seq <= ?",
+                (job_id, seq + len(lines) - 1 - _OUTPUT_CAP),
+            )
+            self._conn.commit()
+
+    def list_outputs(self, job_id: str, after_seq: int = -1, limit: int = 1000) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT seq, line FROM job_outputs WHERE job_id=? AND seq > ? "
+                "ORDER BY seq LIMIT ?",
+                (job_id, after_seq, limit),
             ).fetchall()
         return [dict(r) for r in rows]
 

@@ -91,6 +91,11 @@ class EmbeddedWorkerHandle(WorkerHandle):
         for ep in sorted(self.engine._completed_epochs - self._reported_epochs):
             self._reported_epochs.add(ep)
             self._events.put({"event": "checkpoint_completed", "epoch": ep})
+        from ..connectors.preview import take_preview_rows
+
+        lines = take_preview_rows(self.engine.job_id)
+        if lines:
+            self._events.put({"event": "sink_data", "lines": lines})
 
     def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
         self.engine.trigger_checkpoint(epoch, then_stop=then_stop)

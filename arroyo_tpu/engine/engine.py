@@ -207,6 +207,17 @@ class Engine:
                     {"operators": list({k[0] for k in ep})},
                 )
                 self._completed_epochs.add(epoch)
+                # two-phase commit: metadata is durable, tell committing
+                # sinks to finalize (reference send_commit_messages,
+                # job_controller/mod.rs:838)
+                for key, task in self.tasks.items():
+                    if key in self._finished_tasks:
+                        continue
+                    opv = getattr(task, "operator", None)
+                    if opv is not None and getattr(opv, "is_committing", lambda: False)():
+                        task.control_queue.put(
+                            ControlMessage(kind="commit", epoch=epoch)
+                        )
 
     # -------------------------------------------------------------- control
 

@@ -172,6 +172,7 @@ class Task:
         pending: deque[tuple[int, Union[Batch, Signal]]] = deque()
         last_merged: Optional[Watermark] = None
         stopping = False
+        stop_epoch: Optional[int] = None
 
         tick_us = op.tick_interval_micros()
         tick_s = tick_us / 1e6 if tick_us else None
@@ -199,7 +200,7 @@ class Task:
         def try_complete_alignment():
             """If every live input delivered the barrier, checkpoint and
             unblock held inputs; honors checkpoint-then-stop."""
-            nonlocal current_barrier, stopping
+            nonlocal current_barrier, stopping, stop_epoch
             if current_barrier is None:
                 return
             live = set(range(self.n_inputs)) - finished
@@ -207,6 +208,7 @@ class Task:
                 run_checkpoint(current_barrier)
                 if current_barrier.then_stop:
                     stopping = True
+                    stop_epoch = current_barrier.epoch
                 current_barrier = None
                 barrier_inputs.clear()
                 blocked.clear()
@@ -216,7 +218,20 @@ class Task:
                     pending.extend(held[i])
                 held.clear()
 
+        def drain_control():
+            """Out-of-band engine->task messages; commits arrive here after
+            the epoch's job-level metadata is durable (reference
+            ControlMessage::Commit via WorkerGrpc, operator.rs:1157)."""
+            while True:
+                try:
+                    msg = self.control_queue.get_nowait()
+                except _queue.Empty:
+                    return
+                if msg.kind == "commit" and msg.epoch is not None:
+                    op.handle_commit(msg.epoch, self.ctx)
+
         while True:
+            drain_control()
             if pending:
                 idx, item = pending.popleft()
             else:
@@ -273,5 +288,20 @@ class Task:
             if stopping:
                 # checkpoint-then-stop: everything after the stopping barrier
                 # (held items, EndOfData) is post-snapshot and must NOT be
-                # processed — it would mutate state past what was persisted
+                # processed — it would mutate state past what was persisted.
+                # Committing operators first wait for the engine's commit of
+                # the stopping epoch (reference: CheckpointStopping sends
+                # commits before workers exit) or their phase-1 data would
+                # never be finalized.
+                if op.is_committing() and stop_epoch is not None:
+                    deadline = time.monotonic() + 30
+                    committed = False
+                    while time.monotonic() < deadline and not committed:
+                        try:
+                            msg = self.control_queue.get(timeout=0.1)
+                        except _queue.Empty:
+                            continue
+                        if msg.kind == "commit" and msg.epoch == stop_epoch:
+                            op.handle_commit(msg.epoch, self.ctx)
+                            committed = True
                 break

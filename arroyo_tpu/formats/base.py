@@ -1,0 +1,119 @@
+"""Shared deserializer machinery: rows -> columnar batches with flush policy.
+
+Reference: ArrowDeserializer (crates/arroyo-formats/src/de.rs:249) —
+incremental batch building with size/linger flush (should_flush de.rs:498)
+and the BadData::{Drop,Fail} policy; format-specific subclasses only turn
+payload bytes into row dicts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..batch import STRING, TIMESTAMP_FIELD, Batch, Schema
+
+
+class BadDataError(ValueError):
+    pass
+
+
+class RowBatchingDeserializer:
+    """Accumulates decoded rows, flushing by batch size / linger."""
+
+    def __init__(
+        self,
+        schema: Schema,
+        batch_size: int = 512,
+        linger_micros: int = 100_000,
+        bad_data: str = "fail",
+        event_time_field: Optional[str] = None,
+    ):
+        self.schema = schema
+        self.batch_size = batch_size
+        self.linger_micros = linger_micros
+        self.bad_data = bad_data
+        self.event_time_field = event_time_field
+        self._rows: list[dict] = []
+        self._first_buffer_time: Optional[float] = None
+        self.errors = 0
+
+    # -- subclass hook -------------------------------------------------------
+
+    def _decode(self, payload) -> list[dict]:
+        """payload (bytes/str) -> row dicts; raise on malformed input."""
+        raise NotImplementedError
+
+    # -- public API ----------------------------------------------------------
+
+    def deserialize(self, payload, timestamp_micros: Optional[int] = None) -> None:
+        try:
+            rows = self._decode(payload)
+        except Exception:
+            if self.bad_data == "drop":
+                self.errors += 1
+                return
+            raise
+        if not rows:
+            return
+        if timestamp_micros is not None:
+            for r in rows:
+                r.setdefault(TIMESTAMP_FIELD, timestamp_micros)
+        if self._first_buffer_time is None:
+            self._first_buffer_time = time.monotonic()
+        self._rows.extend(rows)
+
+    def should_flush(self) -> bool:
+        if len(self._rows) >= self.batch_size:
+            return True
+        return (
+            bool(self._rows)
+            and self._first_buffer_time is not None
+            and (time.monotonic() - self._first_buffer_time) * 1e6 >= self.linger_micros
+        )
+
+    def flush(self) -> Optional[Batch]:
+        if not self._rows:
+            return None
+        rows, self._rows = self._rows, []
+        self._first_buffer_time = None
+        return rows_to_batch(rows, self.schema, self.event_time_field)
+
+
+def rows_to_batch(
+    rows: list[dict], schema: Schema, event_time_field: Optional[str] = None
+) -> Batch:
+    from .json_fmt import parse_iso_micros
+
+    cols: dict[str, np.ndarray] = {}
+    for f in schema.fields:
+        if f.name == TIMESTAMP_FIELD:
+            continue
+        vals = [r.get(f.name) for r in rows]
+        if f.dtype == "timestamp":
+            cols[f.name] = np.array(
+                [0 if v is None else parse_iso_micros(v) for v in vals], dtype=np.int64
+            )
+        elif f.dtype == STRING:
+            cols[f.name] = np.array(
+                [None if v is None else str(v) for v in vals], dtype=object
+            )
+        elif f.dtype in ("float32", "float64"):
+            cols[f.name] = np.array(
+                [np.nan if v is None else float(v) for v in vals], dtype=f.numpy_dtype()
+            )
+        elif f.dtype == "bool":
+            cols[f.name] = np.array([bool(v) for v in vals], dtype=np.bool_)
+        else:
+            cols[f.name] = np.array(
+                [0 if v is None else int(v) for v in vals], dtype=f.numpy_dtype()
+            )
+    if event_time_field:
+        cols[TIMESTAMP_FIELD] = np.asarray(cols[event_time_field]).astype(np.int64)
+    else:
+        now = int(time.time() * 1e6)
+        ts = [r.get(TIMESTAMP_FIELD, now) for r in rows]
+        cols[TIMESTAMP_FIELD] = np.array(ts, dtype=np.int64)
+    return Batch(cols)

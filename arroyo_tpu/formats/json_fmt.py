@@ -15,12 +15,9 @@ from typing import Iterable, Optional
 import numpy as np
 
 from ..batch import STRING, TIMESTAMP_FIELD, Batch, Field, Schema
+from .base import BadDataError, RowBatchingDeserializer
 
 IS_RETRACT_FIELD = "_is_retract"
-
-
-class BadDataError(ValueError):
-    pass
 
 
 def parse_iso_micros(v) -> int:
@@ -55,87 +52,24 @@ def format_iso_micros(us: int) -> str:
     return f"{base}.{frac:06d}"
 
 
-class JsonDeserializer:
+class JsonDeserializer(RowBatchingDeserializer):
     """Accumulates JSON objects into columns, flushing by size/linger
-    (reference de.rs:402,498). bad_data: "fail" | "drop"."""
+    (reference de.rs:402,498). bad_data: "fail" | "drop".
+    ``unstructured=True`` puts the raw text into a single "value" column
+    (reference Json{unstructured} formats.rs)."""
 
-    def __init__(
-        self,
-        schema: Schema,
-        batch_size: int = 512,
-        linger_micros: int = 100_000,
-        bad_data: str = "fail",
-        event_time_field: Optional[str] = None,
-    ):
-        self.schema = schema
-        self.batch_size = batch_size
-        self.linger_micros = linger_micros
-        self.bad_data = bad_data
-        self.event_time_field = event_time_field
-        self._rows: list[dict] = []
-        self._first_buffer_time: Optional[float] = None
-        self.errors = 0
+    def __init__(self, *args, unstructured: bool = False, **kw):
+        super().__init__(*args, **kw)
+        self.unstructured = unstructured
 
-    def deserialize(self, line: str | bytes, timestamp_micros: Optional[int] = None) -> None:
-        try:
-            obj = json.loads(line)
-            if not isinstance(obj, dict):
-                raise BadDataError(f"expected JSON object, got {type(obj)}")
-        except Exception:
-            if self.bad_data == "drop":
-                self.errors += 1
-                return
-            raise
-        if timestamp_micros is not None:
-            obj.setdefault(TIMESTAMP_FIELD, timestamp_micros)
-        if self._first_buffer_time is None:
-            self._first_buffer_time = time.monotonic()
-        self._rows.append(obj)
-
-    def should_flush(self) -> bool:
-        if len(self._rows) >= self.batch_size:
-            return True
-        return (
-            bool(self._rows)
-            and self._first_buffer_time is not None
-            and (time.monotonic() - self._first_buffer_time) * 1e6 >= self.linger_micros
-        )
-
-    def flush(self) -> Optional[Batch]:
-        if not self._rows:
-            return None
-        rows, self._rows = self._rows, []
-        self._first_buffer_time = None
-        cols: dict[str, np.ndarray] = {}
-        for f in self.schema.fields:
-            if f.name == TIMESTAMP_FIELD:
-                continue
-            vals = [r.get(f.name) for r in rows]
-            if f.dtype == "timestamp":
-                cols[f.name] = np.array(
-                    [0 if v is None else parse_iso_micros(v) for v in vals], dtype=np.int64
-                )
-            elif f.dtype == STRING:
-                cols[f.name] = np.array(
-                    [None if v is None else str(v) for v in vals], dtype=object
-                )
-            elif f.dtype in ("float32", "float64"):
-                cols[f.name] = np.array(
-                    [np.nan if v is None else float(v) for v in vals], dtype=f.numpy_dtype()
-                )
-            elif f.dtype == "bool":
-                cols[f.name] = np.array([bool(v) for v in vals], dtype=np.bool_)
-            else:
-                cols[f.name] = np.array(
-                    [0 if v is None else int(v) for v in vals], dtype=f.numpy_dtype()
-                )
-        if self.event_time_field:
-            cols[TIMESTAMP_FIELD] = np.asarray(cols[self.event_time_field]).astype(np.int64)
-        else:
-            now = int(time.time() * 1e6)
-            ts = [r.get(TIMESTAMP_FIELD, now) for r in rows]
-            cols[TIMESTAMP_FIELD] = np.array(ts, dtype=np.int64)
-        return Batch(cols)
+    def _decode(self, payload) -> list[dict]:
+        if self.unstructured:
+            text = payload.decode() if isinstance(payload, bytes) else str(payload)
+            return [{"value": text}]
+        obj = json.loads(payload)
+        if not isinstance(obj, dict):
+            raise BadDataError(f"expected JSON object, got {type(obj)}")
+        return [obj]
 
 
 def serialize_json_lines(

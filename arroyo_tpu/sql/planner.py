@@ -1096,9 +1096,13 @@ class Planner:
         rel = self.plan_select(q)
         rows: list = []
         sid = self._id("sink", "preview")
-        self._add_node(sid, OpName.SINK, {"connector": "vec", "rows": rows}, parallelism=1)
+        self._add_node(
+            sid, OpName.SINK,
+            {"connector": "preview", "rows": rows, "schema": rel.schema()},
+            parallelism=1,
+        )
         self._edge(rel, sid, EdgeType.FORWARD, rel.schema())
-        self.sinks.append(SinkInfo(sid, "<preview>", "vec", rows))
+        self.sinks.append(SinkInfo(sid, "<preview>", "preview", rows))
 
 
 def plan_query(sql: str, parallelism: int = 1) -> PlannedPipeline:

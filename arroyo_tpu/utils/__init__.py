@@ -1,0 +1,5 @@
+"""Shared utilities (host-runtime helpers)."""
+
+from .arrow import ensure_parquet_initialized
+
+__all__ = ["ensure_parquet_initialized"]

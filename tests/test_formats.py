@@ -1,0 +1,212 @@
+"""Format layer: avro / protobuf / raw / framing / debezium / bad-data.
+
+Reference test model: round-trip tests in crates/arroyo-formats
+(avro de/ser, proto/test/, framing in de.rs tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from arroyo_tpu.batch import TIMESTAMP_FIELD, Batch, Schema
+from arroyo_tpu.formats.avro_fmt import (
+    AvroSchema,
+    decode_confluent,
+    decode_datum,
+    encode_confluent,
+    encode_datum,
+    read_ocf,
+    write_ocf,
+)
+from arroyo_tpu.formats.framing import frame_iter, frame_join
+from arroyo_tpu.formats.registry import make_deserializer, serialize_batch
+from arroyo_tpu.formats.schema_registry import InMemorySchemaRegistry
+
+AVRO_SCHEMA = {
+    "type": "record",
+    "name": "Bid",
+    "fields": [
+        {"name": "auction", "type": "long"},
+        {"name": "price", "type": "double"},
+        {"name": "bidder", "type": ["null", "string"]},
+        {"name": "fast", "type": "boolean"},
+        {"name": "ts", "type": {"type": "long", "logicalType": "timestamp-micros"}},
+        {"name": "tags", "type": {"type": "array", "items": "string"}},
+    ],
+}
+
+ROWS = [
+    {"auction": 1001, "price": 2.5, "bidder": "alice", "fast": True,
+     "ts": 1696871600000000, "tags": ["a", "b"]},
+    {"auction": -7, "price": -0.25, "bidder": None, "fast": False,
+     "ts": 1696871600500000, "tags": []},
+]
+
+
+def test_avro_datum_roundtrip():
+    sch = AvroSchema(AVRO_SCHEMA)
+    for row in ROWS:
+        assert decode_datum(sch, encode_datum(sch, row)) == row
+
+
+def test_avro_confluent_wire_format():
+    sch = AvroSchema(AVRO_SCHEMA)
+    msg = encode_confluent(sch, 42, ROWS[0])
+    sid, row = decode_confluent(sch, msg)
+    assert sid == 42 and row == ROWS[0]
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_avro_ocf_roundtrip(codec):
+    sch = AvroSchema(AVRO_SCHEMA)
+    data = write_ocf(sch, ROWS, codec=codec)
+    sch2, rows = read_ocf(data)
+    assert rows == ROWS
+    assert sch2.field_names() == sch.field_names()
+
+
+def test_avro_timestamp_millis_normalized():
+    sch = AvroSchema({
+        "type": "record", "name": "R",
+        "fields": [{"name": "t", "type": {"type": "long", "logicalType": "timestamp-millis"}}],
+    })
+    out = decode_datum(sch, encode_datum(sch, {"t": 1696871600123000}))
+    assert out["t"] == 1696871600123000  # stays micros through the round trip
+
+
+def test_avro_deserializer_builds_batches():
+    sch = Schema.of([
+        ("auction", "int64"), ("price", "float64"), ("bidder", "string"),
+        ("fast", "bool"), ("ts", "timestamp"), (TIMESTAMP_FIELD, "int64"),
+    ])
+    de = make_deserializer(
+        {"format": "avro", "avro.schema": json.dumps(AVRO_SCHEMA),
+         "event_time_field": "ts"},
+        sch,
+    )
+    asch = AvroSchema(AVRO_SCHEMA)
+    for r in ROWS:
+        de.deserialize(encode_datum(asch, r))
+    b = de.flush()
+    assert b.num_rows == 2
+    assert list(b["auction"]) == [1001, -7]
+    assert list(b.timestamps) == [r["ts"] for r in ROWS]
+    assert b["bidder"][1] is None
+
+
+def test_framing_newline_and_length():
+    msgs = [b"one", b"two", b"three"]
+    assert list(frame_iter(frame_join(msgs, "newline"), "newline")) == msgs
+    assert list(frame_iter(frame_join(msgs, "length"), "length")) == msgs
+    assert list(frame_iter(b"solo", None)) == [b"solo"]
+    with pytest.raises(ValueError):
+        list(frame_iter(b"\x00\x00\x00\x09abc", "length"))  # overrun
+
+
+def test_raw_string_roundtrip():
+    sch = Schema.of([("value", "string"), (TIMESTAMP_FIELD, "int64")])
+    de = make_deserializer({"format": "raw_string"}, sch)
+    de.deserialize(b"hello", timestamp_micros=5)
+    de.deserialize("world", timestamp_micros=6)
+    b = de.flush()
+    assert list(b["value"]) == ["hello", "world"]
+    out = serialize_batch({"format": "raw_string"}, b, sch)
+    assert out == [b"hello", b"world"]
+
+
+def test_debezium_json_to_updating_rows():
+    sch = Schema.of([
+        ("id", "int64"), ("v", "int64"), ("_is_retract", "bool"),
+        (TIMESTAMP_FIELD, "int64"),
+    ])
+    de = make_deserializer({"format": "debezium_json"}, sch)
+    de.deserialize(json.dumps({"op": "c", "before": None, "after": {"id": 1, "v": 10}}),
+                   timestamp_micros=1)
+    de.deserialize(json.dumps({"op": "u", "before": {"id": 1, "v": 10},
+                               "after": {"id": 1, "v": 11}}), timestamp_micros=2)
+    de.deserialize(json.dumps({"op": "d", "before": {"id": 1, "v": 11}, "after": None}),
+                   timestamp_micros=3)
+    b = de.flush()
+    assert list(b["_is_retract"]) == [False, True, False, True]
+    assert list(b["v"]) == [10, 10, 11, 11]
+
+
+def test_bad_data_drop_vs_fail():
+    sch = Schema.of([("x", "int64"), (TIMESTAMP_FIELD, "int64")])
+    de = make_deserializer({"format": "json", "bad_data": "drop"}, sch)
+    de.deserialize(b"not json", timestamp_micros=1)
+    de.deserialize(json.dumps({"x": 1}), timestamp_micros=1)
+    b = de.flush()
+    assert b.num_rows == 1 and de.errors == 1
+    de2 = make_deserializer({"format": "json", "bad_data": "fail"}, sch)
+    with pytest.raises(Exception):
+        de2.deserialize(b"not json")
+
+
+def test_protobuf_roundtrip(tmp_path):
+    proto = tmp_path / "bid.proto"
+    proto.write_text(
+        'syntax = "proto3";\n'
+        "message Bid { int64 auction = 1; double price = 2; string bidder = 3; }\n"
+    )
+    desc = tmp_path / "bid.desc"
+    subprocess.run(
+        ["protoc", f"--descriptor_set_out={desc}", "--proto_path", str(tmp_path),
+         str(proto)],
+        check=True,
+    )
+    sch = Schema.of([
+        ("auction", "int64"), ("price", "float64"), ("bidder", "string"),
+        (TIMESTAMP_FIELD, "int64"),
+    ])
+    cfg = {"format": "protobuf", "proto.descriptor_file": str(desc),
+           "proto.message_name": "Bid"}
+    rows = [{"auction": 5, "price": 1.5, "bidder": "bob"},
+            {"auction": 6, "price": 0.0, "bidder": ""}]
+    b_in = Batch({
+        "auction": np.array([5, 6], dtype=np.int64),
+        "price": np.array([1.5, 0.0]),
+        "bidder": np.array(["bob", ""], dtype=object),
+        TIMESTAMP_FIELD: np.array([1, 2], dtype=np.int64),
+    })
+    msgs = serialize_batch(cfg, b_in, sch)
+    de = make_deserializer(cfg, sch)
+    for m in msgs:
+        de.deserialize(m, timestamp_micros=9)
+    b = de.flush()
+    assert list(b["auction"]) == [5, 6]
+    assert list(b["bidder"]) == ["bob", ""]
+    assert b["price"][0] == 1.5
+
+
+def test_in_memory_schema_registry():
+    reg = InMemorySchemaRegistry()
+    sid = reg.register("bids-value", json.dumps(AVRO_SCHEMA))
+    assert reg.get_schema_by_id(sid) == json.dumps(AVRO_SCHEMA)
+    assert reg.get_latest("bids-value") == (sid, json.dumps(AVRO_SCHEMA))
+    assert reg.register("other", json.dumps(AVRO_SCHEMA)) == sid  # dedup
+
+
+def test_sql_pipeline_with_raw_string_format(tmp_path, _storage):
+    """SQL DDL format option drives the registry end-to-end."""
+    import arroyo_tpu
+    from arroyo_tpu.engine.engine import run_graph
+    from arroyo_tpu.sql import plan_query
+
+    arroyo_tpu._load_operators()
+    inp = tmp_path / "lines.txt"
+    inp.write_text("apple\nbanana\navocado\n")
+    sql = f"""
+    CREATE TABLE lines (value TEXT) WITH (
+      connector = 'single_file', path = '{inp}', format = 'raw_string',
+      type = 'source');
+    SELECT upper(value) AS shout FROM lines WHERE value LIKE 'a%';
+    """
+    pp = plan_query(sql)
+    run_graph(pp.graph, job_id="raw", timeout=60)
+    assert sorted(r["shout"] for r in pp.sinks[0].rows) == ["APPLE", "AVOCADO"]
