@@ -20,11 +20,8 @@ import json
 import os
 from typing import Optional
 
-import numpy as np
-
-from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch, Schema
+from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Schema
 from ..config import config
-from ..formats.json_fmt import serialize_json_lines
 from ..operators.base import Operator, SourceOperator, TableSpec
 from ..types import SourceFinishType
 from . import register_sink, register_source

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import time
 import urllib.request
+from collections import deque
 from typing import Optional
 from urllib.parse import urlparse
 
@@ -70,8 +71,17 @@ class SSESource(SourceOperator):
         if resp.status != 200:
             raise RuntimeError(f"SSE endpoint returned {resp.status}")
         de = make_deserializer(self.cfg, self.schema)
-        resp.fp.raw._sock.settimeout(0.2)  # poll control between reads
+        # short socket timeout so control messages are polled between reads
+        # (close-delimited responses detach conn.sock -> reach it via resp.fp)
+        sock = conn.sock if conn.sock is not None else resp.fp.raw._sock
+        sock.settimeout(0.2)
 
+        # own line accumulator over resp.read1 (which applies chunked
+        # transfer decoding, unlike reading resp.fp directly) so a timeout
+        # mid-line never discards the partial line
+        acc = bytearray()
+        lines: deque[bytes] = deque()
+        stream_done = False
         data_lines: list[str] = []
         event_type = "message"
         while True:
@@ -88,22 +98,36 @@ class SSESource(SourceOperator):
                         return SourceFinishType.FINAL
                 elif msg.kind == "stop":
                     return SourceFinishType.IMMEDIATE
-            try:
-                raw = resp.fp.readline()
-            except TimeoutError:
-                if de.should_flush():
+            if not lines:
+                if stream_done:
                     b = de.flush()
                     if b is not None:
                         collector.collect(b)
+                    return SourceFinishType.GRACEFUL
+                try:
+                    chunk = resp.read1(65536)
+                except (TimeoutError, OSError):
+                    if de.should_flush():
+                        b = de.flush()
+                        if b is not None:
+                            collector.collect(b)
+                    continue
+                if not chunk:
+                    stream_done = True
+                    if acc:
+                        lines.append(bytes(acc))
+                        acc.clear()
+                    continue
+                acc += chunk
+                while True:
+                    nl = acc.find(b"\n")
+                    if nl < 0:
+                        break
+                    lines.append(bytes(acc[:nl]))
+                    del acc[: nl + 1]
                 continue
-            except OSError:
-                continue
-            if not raw:
-                b = de.flush()
-                if b is not None:
-                    collector.collect(b)
-                return SourceFinishType.GRACEFUL  # stream closed
-            line = raw.decode("utf-8").rstrip("\n").rstrip("\r")
+            raw = lines.popleft()
+            line = raw.decode("utf-8").rstrip("\r")
             if not line:  # dispatch event
                 if data_lines and (self.event_filter is None or event_type in self.event_filter):
                     de.deserialize(

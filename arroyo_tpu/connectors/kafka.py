@@ -231,15 +231,34 @@ class KafkaSink(Operator):
         if self.exactly_once:
             self._commit_epoch(epoch, ctx)
 
+    def _marker_path(self, epoch: int, ctx) -> str:
+        import os
+
+        ti = ctx.task_info
+        d = os.path.join(ctx.table_manager.storage_url, ti.job_id, "commits")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{ti.node_id}-{ti.subtask_index:03d}-{epoch:07d}.done")
+
     def _commit_epoch(self, epoch: int, ctx) -> None:
+        import os
+
         payloads = self.pending.pop(epoch, None)
         if payloads is None:
             return
+        if os.path.exists(self._marker_path(epoch, ctx)):
+            return  # committed in a previous incarnation; don't re-produce
         if payloads:
             self.producer.begin_transaction()
             for p in payloads:
                 self.producer.produce(self.topic, p)
             self.producer.commit_transaction(30)
+        # durable commit marker NOW (not at the next barrier): a crash after
+        # commit_transaction but before the next checkpoint must not
+        # re-produce this epoch on restore. (The marker-write itself leaves
+        # a sub-millisecond window after broker commit — the unavoidable 2PC
+        # residue without broker-side transaction resumption.)
+        with open(self._marker_path(epoch, ctx), "w") as f:
+            f.write("committed")
         ctx.table_manager.global_keyed("p").insert(
             ctx.task_info.subtask_index,
             {"pending": [(e, list(p)) for e, p in self.pending.items()]},

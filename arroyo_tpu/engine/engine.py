@@ -63,11 +63,23 @@ class Engine:
         job_id: str = "job",
         storage_url: Optional[str] = None,
         restore_epoch: Optional[int] = None,
+        assignment: Optional[dict] = None,
+        worker_index: int = 0,
+        network=None,
     ):
+        """assignment: {(node_id, subtask) -> worker_index} places subtasks
+        on workers (reference compute_assignments, states/scheduling.rs:56);
+        None runs everything in this engine. Remote edges ride ``network``
+        (engine.network.NetworkManager over the C++ data plane)."""
         self.graph = graph
         self.job_id = job_id
         self.storage_url = storage_url or config().get("checkpoint.storage-url")
         self.restore_epoch = restore_epoch
+        self.assignment = assignment
+        self.worker_index = worker_index
+        self.network = network
+        # stable numeric node ids for Quad addressing
+        self._node_index = {nid: i for i, nid in enumerate(sorted(graph.nodes))}
         self.resp_queue: "_queue.Queue[ControlResp]" = _queue.Queue()
         self.tasks: dict[tuple[str, int], Task] = {}
         self._inboxes: dict[tuple[str, int], TaskInbox] = {}
@@ -83,6 +95,16 @@ class Engine:
 
     # -------------------------------------------------------------- building
 
+    def _is_mine(self, nid: str, sub: int) -> bool:
+        if self.assignment is None:
+            return True
+        return self.assignment.get((nid, sub), 0) == self.worker_index
+
+    def _worker_of(self, nid: str, sub: int) -> int:
+        if self.assignment is None:
+            return self.worker_index
+        return self.assignment.get((nid, sub), 0)
+
     def build(self) -> None:
         g = self.graph
         queue_size = config().get("worker.queue-size")
@@ -93,8 +115,31 @@ class Engine:
             in_layout[nid] = [(i, g.nodes[e.src].parallelism) for i, e in enumerate(edges)]
             n_inputs = sum(p for _, p in in_layout[nid])
             for s in range(node.parallelism):
-                if n_inputs:
+                if n_inputs and self._is_mine(nid, s):
                     self._inboxes[(nid, s)] = TaskInbox(n_inputs, queue_size)
+
+        # register network receivers for my tasks' remote inputs. Quads are
+        # (edge_index, src_subtask, dst_node, dst_subtask) — the EDGE index
+        # (not src node) disambiguates parallel edges between one node pair
+        # (e.g. self-join / union-with-self shapes).
+        edge_index = {id(e): i for i, e in enumerate(g.edges)}
+        if self.network is not None:
+            for nid, node in g.nodes.items():
+                base = 0
+                for e in g.in_edges(nid):
+                    src_p = g.nodes[e.src].parallelism
+                    for s in range(node.parallelism):
+                        if not self._is_mine(nid, s):
+                            continue
+                        for u in range(src_p):
+                            if not self._is_mine(e.src, u):
+                                quad = (edge_index[id(e)], u,
+                                        self._node_index[nid], s)
+                                self.network.register_receiver(
+                                    quad, self._inboxes[(nid, s)], base + u
+                                )
+                    base += src_p
+            self.network.start()
 
         for nid, node in g.nodes.items():
             in_edges = g.in_edges(nid)
@@ -110,6 +155,8 @@ class Engine:
                 raise IndexError(i)
 
             for s in range(node.parallelism):
+                if not self._is_mine(nid, s):
+                    continue
                 ti = TaskInfo(self.job_id, nid, node.op.value, s, node.parallelism)
                 out_edges = []
                 for e in g.out_edges(nid):
@@ -120,7 +167,18 @@ class Engine:
                         if de is e:
                             break
                         base += g.nodes[de.src].parallelism
-                    dests = [self._inboxes[(e.dst, d)] for d in range(dst_node.parallelism)]
+                    dests = []
+                    for d in range(dst_node.parallelism):
+                        if self._is_mine(e.dst, d):
+                            dests.append(self._inboxes[(e.dst, d)])
+                        else:
+                            from .network import RemoteDest
+
+                            quad = (edge_index[id(e)], s,
+                                    self._node_index[e.dst], d)
+                            dests.append(RemoteDest(
+                                self.network, self._worker_of(e.dst, d), quad
+                            ))
                     idxs = [base + s] * dst_node.parallelism
                     etype = e.edge_type
                     if etype == EdgeType.FORWARD and dst_node.parallelism != node.parallelism:
@@ -163,7 +221,9 @@ class Engine:
         # start sinks-to-sources so consumers are ready before producers
         for node in reversed(self.graph.topo_order()):
             for s in range(node.parallelism):
-                self.tasks[(node.node_id, s)].start()
+                task = self.tasks.get((node.node_id, s))
+                if task is not None:  # remote subtasks belong to other workers
+                    task.start()
 
     def _collect_resps(self) -> None:
         while True:

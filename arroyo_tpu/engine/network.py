@@ -1,0 +1,123 @@
+"""Cross-worker data plane: remote edges over framed TCP.
+
+Equivalent of crates/arroyo-worker/src/network_manager.rs: Quad-addressed
+frames (src_node, src_subtask, dst_node, dst_subtask) multiplexed over one
+TCP connection per worker pair, payloads being wire-codec batches or
+signals (native/wire.py standing in for Arrow IPC). Backpressure is
+end-to-end: the reader blocks on the destination task's bounded inbox,
+TCP backpressures the sender (reference network_manager.rs:164-195).
+
+The byte transport itself is the C++ host runtime (cpp/arroyo_host.cc
+dp_* functions).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..batch import Batch
+from ..native import MSG_DATA, MSG_SIGNAL, DataPlaneConn, DataPlaneListener
+from ..native.wire import decode_batch, decode_signal, encode_batch, encode_signal
+from ..types import Signal
+
+
+class RemoteDest:
+    """Duck-types TaskInbox.put for the Collector: items sent here travel
+    over the data plane to the owning worker's real inbox."""
+
+    def __init__(self, manager: "NetworkManager", worker: int,
+                 quad: tuple[int, int, int, int]):
+        self.manager = manager
+        self.worker = worker
+        self.quad = quad
+
+    def put(self, input_index: int, item) -> None:
+        # input_index is re-derived on the receiving side from the quad;
+        # it is carried implicitly (registration maps quad -> flat index)
+        conn = self.manager.conn_to(self.worker)
+        if isinstance(item, Batch):
+            conn.send(self.quad, MSG_DATA, encode_batch(item))
+        elif isinstance(item, Signal):
+            conn.send(self.quad, MSG_SIGNAL, encode_signal(item))
+        else:
+            raise TypeError(f"cannot ship {type(item)} over the data plane")
+
+
+class NetworkManager:
+    """Per-worker endpoint: a listener plus lazy outgoing connections."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.listener = DataPlaneListener(host, port)
+        self.host = host
+        self.port = self.listener.port
+        self.peers: dict[int, tuple[str, int]] = {}
+        self._out: dict[int, DataPlaneConn] = {}
+        self._out_lock = threading.Lock()
+        # quad -> (inbox, flat_input_index)
+        self._receivers: dict[tuple[int, int, int, int], tuple] = {}
+        self._accept_thread: Optional[threading.Thread] = None
+        self._reader_threads: list[threading.Thread] = []
+        self._closed = False
+
+    def set_peers(self, peers: dict[int, tuple[str, int]]) -> None:
+        self.peers = dict(peers)
+
+    def register_receiver(self, quad: tuple[int, int, int, int], inbox,
+                          input_index: int) -> None:
+        self._receivers[quad] = (inbox, input_index)
+
+    def start(self) -> None:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="dp-accept"
+        )
+        self._accept_thread.start()
+
+    def conn_to(self, worker: int) -> DataPlaneConn:
+        with self._out_lock:
+            conn = self._out.get(worker)
+            if conn is None:
+                host, port = self.peers[worker]
+                conn = DataPlaneConn.connect(host, port)
+                self._out[worker] = conn
+            return conn
+
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn = self.listener.accept()
+            except Exception:  # noqa: BLE001 - listener closed
+                return
+            t = threading.Thread(
+                target=self._read_loop, args=(conn,), daemon=True, name="dp-reader"
+            )
+            t.start()
+            self._reader_threads.append(t)
+
+    def _read_loop(self, conn: DataPlaneConn) -> None:
+        while True:
+            try:
+                got = conn.recv()
+            except Exception:  # noqa: BLE001 - peer died; tasks see EOF-less stall
+                return
+            if got is None:
+                return
+            quad, mtype, payload = got
+            target = self._receivers.get(quad)
+            if target is None:
+                continue  # late frame for a finished task
+            inbox, input_index = target
+            if mtype == MSG_DATA:
+                inbox.put(input_index, decode_batch(payload))
+            else:
+                inbox.put(input_index, decode_signal(payload))
+
+    def close(self) -> None:
+        self._closed = True
+        self.listener.close()
+        with self._out_lock:
+            for conn in self._out.values():
+                conn.close()
+            self._out.clear()

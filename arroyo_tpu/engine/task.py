@@ -301,7 +301,11 @@ class Task:
                             msg = self.control_queue.get(timeout=0.1)
                         except _queue.Empty:
                             continue
-                        if msg.kind == "commit" and msg.epoch == stop_epoch:
+                        if msg.kind == "commit" and msg.epoch is not None:
+                            # honor EVERY commit (a straggling earlier epoch
+                            # may land here too); done once the stopping
+                            # epoch itself is committed
                             op.handle_commit(msg.epoch, self.ctx)
-                            committed = True
+                            if msg.epoch == stop_epoch:
+                                committed = True
                 break

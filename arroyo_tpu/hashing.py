@@ -51,27 +51,44 @@ def _hash_string_array(col: np.ndarray) -> np.ndarray:
 
 
 def hash_column(col: np.ndarray) -> np.ndarray:
-    """64-bit hash of one column."""
+    """64-bit hash of one column (native C++ path when available,
+    arroyo_tpu.native — same splitmix64 mix, differentially tested)."""
+    from . import native
+
     if col.dtype == object:
         return splitmix64(_hash_string_array(col))
-    if col.dtype == np.bool_:
-        col = col.astype(np.uint64)
     if col.dtype.kind == "f":
+        out = native.hash_f64(col.astype(np.float64))
+        if out is not None:
+            return out
         # canonicalize -0.0 and hash the bit pattern
         col = np.where(col == 0.0, 0.0, col)
         col = col.astype(np.float64).view(np.uint64)
+        return splitmix64(col)
+    if col.dtype == np.bool_:
+        col = col.astype(np.uint64)
     else:
         col = col.astype(np.int64).view(np.uint64)
+    out = native.hash_u64(col)
+    if out is not None:
+        return out
     return splitmix64(col)
 
 
 def hash_columns(cols: list[np.ndarray]) -> np.ndarray:
     """Combined 64-bit hash of several columns (row-wise)."""
+    from . import native
+
     if not cols:
         raise ValueError("need at least one key column")
     h = hash_column(cols[0])
     for c in cols[1:]:
-        h = splitmix64(h ^ (hash_column(c) + _C1))
+        h2 = hash_column(c)
+        combined = native.hash_combine(h, h2)
+        if combined is not None:
+            h = combined
+        else:
+            h = splitmix64(h ^ (h2 + _C1))
     return h
 
 

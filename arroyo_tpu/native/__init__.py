@@ -1,0 +1,331 @@
+"""ctypes bindings for the C++ host runtime (cpp/arroyo_host.cc).
+
+The library is built on first use with `make -C cpp` (g++ is in the image)
+and cached next to the sources. Every entry point has a NumPy fallback so
+the framework still runs if the toolchain is unavailable; the config flag
+``native.enabled`` force-disables the native path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "cpp")
+_LIB_PATH = os.path.join(_CPP_DIR, "libarroyo_host.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(
+            ["make", "-C", _CPP_DIR],
+            capture_output=True, text=True, timeout=120,
+        )
+        return r.returncode == 0 and os.path.exists(_LIB_PATH)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it if needed; None when unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        from ..config import config
+
+        if not config().get("native.enabled", True):
+            _lib_failed = True
+            return None
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(os.path.join(_CPP_DIR, "arroyo_host.cc"))
+            and os.path.getmtime(os.path.join(_CPP_DIR, "arroyo_host.cc"))
+            > os.path.getmtime(_LIB_PATH)
+        ):
+            if not _build():
+                _lib_failed = True
+                return None
+        try:
+            l = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _lib_failed = True
+            return None
+        _declare(l)
+        _lib = l
+        return _lib
+
+
+def _declare(l: ctypes.CDLL) -> None:
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    l.ah_hash_u64.argtypes = [u64p, u64p, ctypes.c_int64]
+    l.ah_hash_combine.argtypes = [u64p, u64p, ctypes.c_int64]
+    l.ah_hash_f64.argtypes = [f64p, u64p, ctypes.c_int64]
+    l.ah_partition.argtypes = [u64p, ctypes.c_int64, ctypes.c_int32, i64p, i64p]
+    l.ah_partition.restype = ctypes.c_int
+    l.ah_parse_json_lines.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(i64p), ctypes.POINTER(f64p), ctypes.POINTER(u8p),
+        ctypes.POINTER(i64p), ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    l.ah_parse_json_lines.restype = ctypes.c_int64
+    l.ah_free.argtypes = [ctypes.c_void_p]
+    l.dp_listen.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    l.dp_listen.restype = ctypes.c_int
+    l.dp_bound_port.argtypes = [ctypes.c_int]
+    l.dp_bound_port.restype = ctypes.c_int
+    l.dp_accept.argtypes = [ctypes.c_int]
+    l.dp_accept.restype = ctypes.c_int
+    l.dp_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    l.dp_connect.restype = ctypes.c_int
+    l.dp_send_frame.argtypes = [
+        ctypes.c_int, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    l.dp_send_frame.restype = ctypes.c_int
+    l.dp_recv_header.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_uint32)]
+    l.dp_recv_header.restype = ctypes.c_int
+    l.dp_recv_payload.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32]
+    l.dp_recv_payload.restype = ctypes.c_int
+    l.dp_close.argtypes = [ctypes.c_int]
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# --------------------------------------------------------------- hashing
+
+
+def _u64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def hash_u64(arr: np.ndarray) -> Optional[np.ndarray]:
+    l = lib()
+    if l is None:
+        return None
+    arr = np.ascontiguousarray(arr, dtype=np.uint64)
+    out = np.empty(len(arr), dtype=np.uint64)
+    l.ah_hash_u64(_u64p(arr), _u64p(out), len(arr))
+    return out
+
+
+def hash_f64(arr: np.ndarray) -> Optional[np.ndarray]:
+    l = lib()
+    if l is None:
+        return None
+    arr = np.ascontiguousarray(arr, dtype=np.float64)
+    out = np.empty(len(arr), dtype=np.uint64)
+    l.ah_hash_f64(arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), _u64p(out), len(arr))
+    return out
+
+
+def hash_combine(h: np.ndarray, h2: np.ndarray) -> Optional[np.ndarray]:
+    l = lib()
+    if l is None:
+        return None
+    h = np.ascontiguousarray(h, dtype=np.uint64).copy()
+    h2 = np.ascontiguousarray(h2, dtype=np.uint64)
+    l.ah_hash_combine(_u64p(h), _u64p(h2), len(h))
+    return h
+
+
+def partition(hashes: np.ndarray, n_dest: int):
+    """(perm, offsets): stable grouping of row indices by destination
+    (native counting sort; None if the library is unavailable)."""
+    l = lib()
+    if l is None:
+        return None
+    hashes = np.ascontiguousarray(hashes, dtype=np.uint64)
+    perm = np.empty(len(hashes), dtype=np.int64)
+    offsets = np.empty(n_dest + 1, dtype=np.int64)
+    rc = l.ah_partition(
+        _u64p(hashes), len(hashes), n_dest,
+        perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if rc != 0:
+        return None
+    return perm, offsets
+
+
+# -------------------------------------------------------------- JSON lines
+
+_KIND = {"int64": 0, "timestamp": 0, "int32": 0, "uint64": 0,
+         "float64": 1, "float32": 1, "bool": 2, "string": 3}
+
+
+def parse_json_lines(data: bytes, fields: list[tuple[str, str]],
+                     max_rows: int) -> Optional[dict[str, np.ndarray]]:
+    """Parse newline-delimited flat JSON objects into columns.
+    fields: (name, dtype) with dtypes from batch.Schema. Returns None when
+    the native library is unavailable or input is malformed (caller falls
+    back to the Python parser, which produces the precise error)."""
+    l = lib()
+    if l is None:
+        return None
+    n_cols = len(fields)
+    if n_cols > 64:
+        return None
+    kinds = np.array([_KIND.get(d, 4) for _n, d in fields], dtype=np.int32)
+    names_blob = b"".join(n.encode() + b"\x00" for n, _d in fields)
+    int_arrays, f64_arrays, bool_arrays, off_arrays = {}, {}, {}, {}
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    int_ptrs = (i64p * n_cols)()
+    f64_ptrs = (f64p * n_cols)()
+    bool_ptrs = (u8p * n_cols)()
+    off_ptrs = (i64p * n_cols)()
+    for c, (_name, _d) in enumerate(fields):
+        k = kinds[c]
+        if k == 0:
+            a = np.zeros(max_rows, dtype=np.int64)
+            int_arrays[c] = a
+            int_ptrs[c] = a.ctypes.data_as(i64p)
+        elif k == 1:
+            a = np.zeros(max_rows, dtype=np.float64)
+            f64_arrays[c] = a
+            f64_ptrs[c] = a.ctypes.data_as(f64p)
+        elif k == 2:
+            a = np.zeros(max_rows, dtype=np.uint8)
+            bool_arrays[c] = a
+            bool_ptrs[c] = a.ctypes.data_as(u8p)
+        elif k == 3:
+            a = np.zeros(max_rows + 1, dtype=np.int64)
+            off_arrays[c] = a
+            off_ptrs[c] = a.ctypes.data_as(i64p)
+    arena = ctypes.c_char_p()
+    arena_len = ctypes.c_int64()
+    n = l.ah_parse_json_lines(
+        data, len(data), n_cols, names_blob,
+        kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), max_rows,
+        int_ptrs, f64_ptrs, bool_ptrs, off_ptrs,
+        ctypes.byref(arena), ctypes.byref(arena_len),
+    )
+    if n < 0:
+        return None
+    try:
+        arena_bytes = ctypes.string_at(arena, arena_len.value) if arena_len.value else b""
+    finally:
+        if arena:
+            l.ah_free(arena)
+    out: dict[str, np.ndarray] = {}
+    from ..batch import Field
+
+    for c, (name, dtype) in enumerate(fields):
+        k = kinds[c]
+        if k == 0:
+            out[name] = int_arrays[c][:n].astype(Field(name, dtype).numpy_dtype(), copy=False)
+        elif k == 1:
+            out[name] = f64_arrays[c][:n].astype(Field(name, dtype).numpy_dtype(), copy=False)
+        elif k == 2:
+            out[name] = bool_arrays[c][:n].astype(bool)
+        elif k == 3:
+            offs = off_arrays[c]
+            col = np.empty(n, dtype=object)
+            for i in range(n):
+                col[i] = arena_bytes[offs[i]:offs[i + 1]].decode("utf-8")
+            out[name] = col
+    return out
+
+
+# -------------------------------------------------------------- data plane
+
+
+class DataPlaneError(RuntimeError):
+    pass
+
+
+MSG_DATA = 0
+MSG_SIGNAL = 1
+
+
+class DataPlaneListener:
+    """Server half (reference network_manager.rs InNetworkLink)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        l = lib()
+        if l is None:
+            raise DataPlaneError("native library unavailable")
+        self._l = l
+        self.fd = l.dp_listen(host.encode(), port)
+        if self.fd < 0:
+            raise DataPlaneError(f"dp_listen failed: {self.fd}")
+        self.port = l.dp_bound_port(self.fd)
+
+    def accept(self) -> "DataPlaneConn":
+        fd = self._l.dp_accept(self.fd)
+        if fd < 0:
+            raise DataPlaneError("dp_accept failed")
+        return DataPlaneConn(fd)
+
+    def close(self) -> None:
+        self._l.dp_close(self.fd)
+
+
+class DataPlaneConn:
+    """One framed TCP link multiplexing all quads between two workers
+    (reference OutNetworkLink, network_manager.rs:211)."""
+
+    def __init__(self, fd: int):
+        self._l = lib()
+        self.fd = fd
+        # one connection is shared by every sending task thread on this
+        # worker pair; header+payload are two writes and must not interleave
+        self._send_lock = threading.Lock()
+
+    @staticmethod
+    def connect(host: str, port: int, retries: int = 10, backoff_ms: int = 50) -> "DataPlaneConn":
+        l = lib()
+        if l is None:
+            raise DataPlaneError("native library unavailable")
+        fd = l.dp_connect(host.encode(), port, retries, backoff_ms)
+        if fd < 0:
+            raise DataPlaneError(f"dp_connect failed: {fd}")
+        return DataPlaneConn(fd)
+
+    def send(self, quad: tuple[int, int, int, int], msg_type: int, payload: bytes) -> None:
+        with self._send_lock:
+            rc = self._l.dp_send_frame(
+                self.fd, quad[0], quad[1], quad[2], quad[3], msg_type,
+                payload, len(payload),
+            )
+        if rc != 0:
+            raise DataPlaneError("dp_send_frame failed (peer closed?)")
+
+    def recv(self):
+        """-> (quad, msg_type, payload bytes) or None on clean close."""
+        header = (ctypes.c_uint32 * 6)()
+        rc = self._l.dp_recv_header(self.fd, header)
+        if rc == -1:
+            return None
+        if rc != 0:
+            raise DataPlaneError(f"dp_recv_header failed: {rc}")
+        n = header[5]
+        buf = ctypes.create_string_buffer(n) if n else None
+        if n:
+            if self._l.dp_recv_payload(self.fd, buf, n) != 0:
+                raise DataPlaneError("dp_recv_payload failed")
+        quad = (header[0], header[1], header[2], header[3])
+        return quad, header[4], (buf.raw[:n] if n else b"")
+
+    def close(self) -> None:
+        self._l.dp_close(self.fd)

@@ -64,10 +64,18 @@ class Collector:
 
     def _shuffle_keyed(self, batch: Batch, edge: OutEdge) -> None:
         n = len(edge.dests)
-        dests = servers_for_hashes(batch.keys, n)
-        order = np.argsort(dests, kind="stable")
-        sorted_dests = dests[order]
-        bounds = np.searchsorted(sorted_dests, np.arange(n + 1))
+        from .. import native
+
+        part = native.partition(batch.keys, n)
+        if part is not None:
+            # native counting-sort permutation (cpp/arroyo_host.cc
+            # ah_partition — the reference's repartition hot path)
+            order, bounds = part
+        else:
+            dests = servers_for_hashes(batch.keys, n)
+            order = np.argsort(dests, kind="stable")
+            sorted_dests = dests[order]
+            bounds = np.searchsorted(sorted_dests, np.arange(n + 1))
         sorted_batch = batch.take(order)
         for d in range(n):
             lo, hi = bounds[d], bounds[d + 1]

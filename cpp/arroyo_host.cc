@@ -1,0 +1,489 @@
+// arroyo-tpu C++ host runtime.
+//
+// Native equivalents of the reference engine's hot host-side paths, which in
+// the reference are Rust inside arroyo-worker/arroyo-operator:
+//   - 64-bit key hashing            (context.rs:512 create_hashes analog;
+//                                    splitmix64 mix, matching hashing.py)
+//   - keyed repartition permutation (context.rs:502-556 repartition)
+//   - JSON-lines columnar parsing   (arroyo-formats de.rs hot loop)
+//   - framed TCP data plane         (worker/src/network_manager.rs: 24-byte
+//                                    header + payload per frame)
+//
+// Exposed as a plain C ABI consumed via ctypes (arroyo_tpu/native). The
+// compute path stays JAX/XLA/Pallas; this library owns the byte-shoveling
+// around it.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <cstdlib>
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------- hashing
+
+static const uint64_t C1 = 0x9E3779B97F4A7C15ull;
+static const uint64_t C2 = 0xBF58476D1CE4E5B9ull;
+static const uint64_t C3 = 0x94D049BB133111EBull;
+
+static inline uint64_t splitmix64(uint64_t x) {
+  uint64_t z = x + C1;
+  z = (z ^ (z >> 30)) * C2;
+  z = (z ^ (z >> 27)) * C3;
+  return z ^ (z >> 31);
+}
+
+// out[i] = splitmix64(in[i])
+void ah_hash_u64(const uint64_t* in, uint64_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; i++) out[i] = splitmix64(in[i]);
+}
+
+// h[i] = splitmix64(h[i] ^ (h2[i] + C1)) — column combine (hashing.py:74)
+void ah_hash_combine(uint64_t* h, const uint64_t* h2, int64_t n) {
+  for (int64_t i = 0; i < n; i++) h[i] = splitmix64(h[i] ^ (h2[i] + C1));
+}
+
+// float canonicalization: -0.0 -> 0.0, then bitcast (hashing.py:60-62)
+void ah_hash_f64(const double* in, uint64_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; i++) {
+    double v = in[i] == 0.0 ? 0.0 : in[i];
+    uint64_t bits;
+    memcpy(&bits, &v, 8);
+    out[i] = splitmix64(bits);
+  }
+}
+
+// ------------------------------------------------------------ repartition
+
+// Counting-sort permutation of rows by destination subtask.
+// dests_out[i] = min(hash[i] / size, n_dest-1); perm is a stable ordering of
+// row indices grouped by destination; offsets[d]..offsets[d+1] delimit
+// destination d's rows in perm. Returns 0 on success.
+int ah_partition(const uint64_t* hashes, int64_t n_rows, int32_t n_dest,
+                 int64_t* perm, int64_t* offsets /* n_dest+1 */) {
+  if (n_dest <= 0) return -1;
+  if (n_dest == 1) {
+    // size would be 2^64 (wraps to 0): everything goes to destination 0
+    for (int64_t i = 0; i < n_rows; i++) perm[i] = i;
+    offsets[0] = 0;
+    offsets[1] = n_rows;
+    return 0;
+  }
+  const uint64_t size = 0xFFFFFFFFFFFFFFFFull / (uint64_t)n_dest + 1;
+  // counts
+  for (int32_t d = 0; d <= n_dest; d++) offsets[d] = 0;
+  // reuse perm as scratch for per-row destination to avoid a second pass
+  for (int64_t i = 0; i < n_rows; i++) {
+    uint64_t d = hashes[i] / size;
+    if (d >= (uint64_t)n_dest) d = n_dest - 1;
+    perm[i] = (int64_t)d;
+    offsets[d + 1]++;
+  }
+  for (int32_t d = 0; d < n_dest; d++) offsets[d + 1] += offsets[d];
+  // stable scatter
+  int64_t* cursor = (int64_t*)malloc(sizeof(int64_t) * n_dest);
+  if (!cursor) return -2;
+  for (int32_t d = 0; d < n_dest; d++) cursor[d] = offsets[d];
+  // second buffer for output permutation
+  int64_t* out = (int64_t*)malloc(sizeof(int64_t) * (n_rows ? n_rows : 1));
+  if (!out) { free(cursor); return -2; }
+  for (int64_t i = 0; i < n_rows; i++) {
+    int64_t d = perm[i];
+    out[cursor[d]++] = i;
+  }
+  memcpy(perm, out, sizeof(int64_t) * n_rows);
+  free(out);
+  free(cursor);
+  return 0;
+}
+
+// ------------------------------------------------------------- JSON lines
+//
+// Flat-object parser for a fixed schema. Column kinds:
+//   0 = int64, 1 = float64, 2 = bool, 3 = string, 4 = skip/ignore
+// For string columns the caller gets (offsets into a shared byte arena).
+// Missing keys yield 0 / NaN / false / empty. Returns rows parsed, or
+// -(line_index+1) on malformed input.
+
+struct StrArena {
+  char* data;
+  int64_t len;
+  int64_t cap;
+};
+
+static int arena_push(StrArena* a, const char* s, int64_t n) {
+  if (a->len + n > a->cap) {
+    int64_t ncap = a->cap * 2;
+    if (ncap < a->len + n) ncap = a->len + n + 4096;
+    char* nd = (char*)realloc(a->data, ncap);
+    if (!nd) return -1;
+    a->data = nd;
+    a->cap = ncap;
+  }
+  memcpy(a->data + a->len, s, n);
+  a->len += n;
+  return 0;
+}
+
+static const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) p++;
+  return p;
+}
+
+// parse a JSON string starting at the opening quote; unescapes into buf
+// (caller-sized >= input length). Returns pointer past closing quote, or
+// nullptr on error; *out_len = unescaped length.
+static const char* parse_string(const char* p, const char* end, char* buf,
+                                int64_t* out_len) {
+  if (p >= end || *p != '"') return nullptr;
+  p++;
+  int64_t n = 0;
+  while (p < end && *p != '"') {
+    if (*p == '\\' && p + 1 < end) {
+      p++;
+      char c = *p++;
+      switch (c) {
+        case 'n': buf[n++] = '\n'; break;
+        case 't': buf[n++] = '\t'; break;
+        case 'r': buf[n++] = '\r'; break;
+        case 'b': buf[n++] = '\b'; break;
+        case 'f': buf[n++] = '\f'; break;
+        case '"': buf[n++] = '"'; break;
+        case '\\': buf[n++] = '\\'; break;
+        case '/': buf[n++] = '/'; break;
+        case 'u': {
+          if (p + 4 > end) return nullptr;
+          unsigned cp = 0;
+          for (int k = 0; k < 4; k++) {
+            char h = p[k];
+            cp <<= 4;
+            if (h >= '0' && h <= '9') cp |= h - '0';
+            else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+            else return nullptr;
+          }
+          p += 4;
+          // utf-8 encode (BMP only; surrogate pairs pass through as-is)
+          if (cp < 0x80) buf[n++] = (char)cp;
+          else if (cp < 0x800) {
+            buf[n++] = (char)(0xC0 | (cp >> 6));
+            buf[n++] = (char)(0x80 | (cp & 0x3F));
+          } else {
+            buf[n++] = (char)(0xE0 | (cp >> 12));
+            buf[n++] = (char)(0x80 | ((cp >> 6) & 0x3F));
+            buf[n++] = (char)(0x80 | (cp & 0x3F));
+          }
+          break;
+        }
+        default: return nullptr;
+      }
+    } else {
+      buf[n++] = *p++;
+    }
+  }
+  if (p >= end) return nullptr;
+  *out_len = n;
+  return p + 1;  // past closing quote
+}
+
+// skip any JSON value (for unknown keys / nested objects)
+static const char* skip_value(const char* p, const char* end) {
+  p = skip_ws(p, end);
+  if (p >= end) return nullptr;
+  if (*p == '"') {
+    p++;
+    while (p < end && *p != '"') {
+      if (*p == '\\') p++;
+      p++;
+    }
+    return p < end ? p + 1 : nullptr;
+  }
+  if (*p == '{' || *p == '[') {
+    char open = *p, close = (*p == '{') ? '}' : ']';
+    int depth = 0;
+    bool in_str = false;
+    while (p < end) {
+      if (in_str) {
+        if (*p == '\\') p++;
+        else if (*p == '"') in_str = false;
+      } else if (*p == '"') in_str = true;
+      else if (*p == open) depth++;
+      else if (*p == close) {
+        depth--;
+        if (depth == 0) return p + 1;
+      }
+      p++;
+    }
+    return nullptr;
+  }
+  while (p < end && *p != ',' && *p != '}' && *p != ']' && *p != ' ' &&
+         *p != '\n' && *p != '\t' && *p != '\r')
+    p++;
+  return p;
+}
+
+// data: newline-separated JSON objects. Schema: n_cols columns with names
+// (concatenated, NUL-separated) and kinds. Outputs: per-column arrays sized
+// max_rows; string columns write (str_offsets[col][row+1] ends) into one
+// shared arena returned via *arena_out/*arena_len (caller frees with
+// ah_free). Bool columns are uint8. null -> 0/NaN/false/empty.
+int64_t ah_parse_json_lines(const char* data, int64_t data_len,
+                            int32_t n_cols, const char* names_blob,
+                            const int32_t* kinds, int64_t max_rows,
+                            int64_t** int_cols, double** f64_cols,
+                            uint8_t** bool_cols, int64_t** str_offsets,
+                            char** arena_out, int64_t* arena_len) {
+  // resolve column names
+  const char* names[64];
+  int64_t name_lens[64];
+  if (n_cols > 64) return -1000000;
+  {
+    const char* p = names_blob;
+    for (int32_t c = 0; c < n_cols; c++) {
+      names[c] = p;
+      name_lens[c] = strlen(p);
+      p += name_lens[c] + 1;
+    }
+  }
+  StrArena arena = {(char*)malloc(4096), 0, 4096};
+  if (!arena.data) return -1000001;
+  char* strbuf = (char*)malloc(data_len + 8);
+  if (!strbuf) { free(arena.data); return -1000001; }
+
+  // initialize string offsets row 0
+  for (int32_t c = 0; c < n_cols; c++)
+    if (kinds[c] == 3) str_offsets[c][0] = 0;
+
+  const char* p = data;
+  const char* end = data + data_len;
+  int64_t row = 0;
+  int64_t line_no = 0;
+  while (p < end && row < max_rows) {
+    const char* line_end = (const char*)memchr(p, '\n', end - p);
+    if (!line_end) line_end = end;
+    const char* q = skip_ws(p, line_end);
+    if (q == line_end) { p = line_end + 1; line_no++; continue; }
+    if (*q != '{') goto fail;
+    q++;
+    // defaults for this row
+    for (int32_t c = 0; c < n_cols; c++) {
+      switch (kinds[c]) {
+        case 0: int_cols[c][row] = 0; break;
+        case 1: f64_cols[c][row] = __builtin_nan(""); break;
+        case 2: bool_cols[c][row] = 0; break;
+        case 3: str_offsets[c][row + 1] = arena.len; break;
+        default: break;
+      }
+    }
+    while (true) {
+      q = skip_ws(q, line_end);
+      if (q < line_end && *q == '}') { q++; break; }
+      int64_t klen;
+      q = parse_string(q, line_end, strbuf, &klen);
+      if (!q) goto fail;
+      q = skip_ws(q, line_end);
+      if (q >= line_end || *q != ':') goto fail;
+      q++;
+      q = skip_ws(q, line_end);
+      // find the column
+      int32_t col = -1;
+      for (int32_t c = 0; c < n_cols; c++) {
+        if (name_lens[c] == klen && memcmp(names[c], strbuf, klen) == 0) {
+          col = c;
+          break;
+        }
+      }
+      if (col < 0 || kinds[col] == 4) {
+        q = skip_value(q, line_end);
+        if (!q) goto fail;
+      } else if (kinds[col] == 3) {
+        if (q < line_end && *q == '"') {
+          int64_t slen;
+          q = parse_string(q, line_end, strbuf, &slen);
+          if (!q) goto fail;
+          if (arena_push(&arena, strbuf, slen) != 0) goto fail;
+        } else {
+          // null / non-string: empty string
+          q = skip_value(q, line_end);
+          if (!q) goto fail;
+        }
+        str_offsets[col][row + 1] = arena.len;
+      } else if (q < line_end && (*q == 'n')) {  // null
+        q = skip_value(q, line_end);
+        if (!q) goto fail;
+      } else if (kinds[col] == 2) {
+        if (q + 4 <= line_end && memcmp(q, "true", 4) == 0) {
+          bool_cols[col][row] = 1;
+          q += 4;
+        } else if (q + 5 <= line_end && memcmp(q, "false", 5) == 0) {
+          bool_cols[col][row] = 0;
+          q += 5;
+        } else goto fail;
+      } else {
+        char* numend;
+        if (kinds[col] == 0) {
+          long long v = strtoll(q, &numend, 10);
+          if (numend == q) goto fail;
+          // float-typed input into int column: fall back to strtod
+          if (numend < line_end && (*numend == '.' || *numend == 'e' || *numend == 'E')) {
+            double dv = strtod(q, &numend);
+            v = (long long)dv;
+          }
+          int_cols[col][row] = v;
+        } else {
+          double v = strtod(q, &numend);
+          if (numend == q) goto fail;
+          f64_cols[col][row] = v;
+        }
+        q = numend;
+      }
+      q = skip_ws(q, line_end);
+      if (q < line_end && *q == ',') q++;
+    }
+    row++;
+    line_no++;
+    p = line_end + 1;
+  }
+  *arena_out = arena.data;
+  *arena_len = arena.len;
+  free(strbuf);
+  return row;
+
+fail:
+  free(arena.data);
+  free(strbuf);
+  return -(line_no + 1);
+}
+
+void ah_free(void* p) { free(p); }
+
+// -------------------------------------------------------------- data plane
+//
+// Frame layout (reference network_manager.rs:102-162 — 24-byte LE header):
+//   u32 src_op | u32 src_subtask | u32 dst_op | u32 dst_subtask |
+//   u32 msg_type | u32 len        then `len` payload bytes.
+
+struct FrameHeader {
+  uint32_t src_op, src_subtask, dst_op, dst_subtask, msg_type, len;
+};
+
+static int read_full(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = recv(fd, p + got, n - got, 0);
+    if (r == 0) return -1;  // peer closed
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -2;
+    }
+    got += (size_t)r;
+  }
+  return 0;
+}
+
+static int write_full(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = send(fd, p + sent, n - sent, MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -2;
+    }
+    sent += (size_t)r;
+  }
+  return 0;
+}
+
+int dp_listen(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) { close(fd); return -3; }
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) { close(fd); return -4; }
+  if (listen(fd, 128) != 0) { close(fd); return -5; }
+  return fd;
+}
+
+int dp_bound_port(int fd) {
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (getsockname(fd, (sockaddr*)&addr, &len) != 0) return -1;
+  return ntohs(addr.sin_port);
+}
+
+int dp_accept(int listen_fd) {
+  int fd = accept(listen_fd, nullptr, nullptr);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+int dp_connect(const char* host, int port, int retries, int backoff_ms) {
+  for (int attempt = 0; attempt <= retries; attempt++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) { close(fd); return -3; }
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    close(fd);
+    usleep((useconds_t)backoff_ms * 1000 * (attempt + 1));
+  }
+  return -2;
+}
+
+int dp_send_frame(int fd, uint32_t src_op, uint32_t src_sub, uint32_t dst_op,
+                  uint32_t dst_sub, uint32_t msg_type, const char* payload,
+                  uint32_t len) {
+  FrameHeader h{src_op, src_sub, dst_op, dst_sub, msg_type, len};
+  if (write_full(fd, &h, sizeof(h)) != 0) return -1;
+  if (len && write_full(fd, payload, len) != 0) return -1;
+  return 0;
+}
+
+// Two-phase receive so the caller can size the payload buffer exactly:
+// dp_recv_header fills out_header[6] (src_op, src_sub, dst_op, dst_sub,
+// msg_type, len); returns 0, -1 on clean close, -2 on error. Then
+// dp_recv_payload reads exactly `len` bytes.
+int dp_recv_header(int fd, uint32_t* out_header) {
+  FrameHeader h;
+  int r = read_full(fd, &h, sizeof(h));
+  if (r != 0) return r == -1 ? -1 : -2;
+  out_header[0] = h.src_op;
+  out_header[1] = h.src_subtask;
+  out_header[2] = h.dst_op;
+  out_header[3] = h.dst_subtask;
+  out_header[4] = h.msg_type;
+  out_header[5] = h.len;
+  return 0;
+}
+
+int dp_recv_payload(int fd, char* payload, uint32_t len) {
+  if (len == 0) return 0;
+  return read_full(fd, payload, len);
+}
+
+void dp_close(int fd) { close(fd); }
+
+}  // extern "C"
