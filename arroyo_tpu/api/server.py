@@ -88,6 +88,7 @@ class ApiServer:
         ("PATCH", r"^/api/v1/jobs/([^/]+)$", "_patch_job"),
         ("GET", r"^/api/v1/jobs/([^/]+)/checkpoints$", "_job_checkpoints"),
         ("GET", r"^/api/v1/jobs/([^/]+)/output$", "_job_output"),
+        ("GET", r"^/api/v1/jobs/([^/]+)/metrics$", "_job_metrics"),
         ("GET", r"^/api/v1/connectors$", "_connectors"),
     ]
 
@@ -195,6 +196,17 @@ class ApiServer:
             q = parse_qs(h.path.split("?", 1)[1])
             after = int(q.get("after", ["-1"])[0])
         h._json(200, {"data": self.db.list_outputs(jid, after_seq=after)})
+
+    def _job_metrics(self, h, jid):
+        # DB-persisted snapshots (shipped from workers over the control
+        # protocol) cover the process scheduler; fall back to the local
+        # registry for an in-flight embedded job
+        data = self.db.get_metrics(jid)
+        if data is None:
+            from ..metrics import registry as metrics_registry
+
+            data = metrics_registry.job_metrics(jid)
+        h._json(200, {"data": data})
 
     def _connectors(self, h):
         from ..connectors import connectors

@@ -186,6 +186,16 @@ class Batch:
             for i in range(self.num_rows)
         ]
 
+    def nbytes(self) -> int:
+        """Approximate payload size (object columns estimated)."""
+        total = 0
+        for c in self.columns.values():
+            if c.dtype == object:
+                total += 16 * len(c)
+            else:
+                total += c.nbytes
+        return total
+
     def __repr__(self) -> str:
         return f"Batch(rows={self.num_rows}, cols={list(self.columns.keys())})"
 

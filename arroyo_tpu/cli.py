@@ -76,8 +76,13 @@ def _cmd_cluster(args) -> int:
     from arroyo_tpu.api import ApiServer
     from arroyo_tpu.controller import ControllerServer, Database
     from arroyo_tpu.controller.scheduler import scheduler_for
+    from arroyo_tpu.server_common import AdminServer, init_logging
 
+    init_logging()
     arroyo_tpu._load_operators()
+    from arroyo_tpu.config import config as _cfg
+
+    AdminServer("cluster", port=_cfg().get("admin.http-port", 0)).start()
     db = Database(args.db or ":memory:")
     api = ApiServer(db, port=args.api_port).start()
     controller = ControllerServer(db, scheduler_for(args.scheduler)).start()
@@ -116,6 +121,11 @@ def _cmd_worker(args) -> int:
     from arroyo_tpu.sql.planner import set_parallelism
 
     arroyo_tpu._load_operators()
+    from arroyo_tpu.server_common import AdminServer
+
+    # per-worker admin endpoint on an ephemeral port (reference: every
+    # service runs one, arroyo-server-common lib.rs:280)
+    AdminServer("worker", port=0).start()
 
     def emit(obj: dict) -> None:
         sys.stdout.write(json.dumps(obj) + "\n")
@@ -172,6 +182,9 @@ def _cmd_worker(args) -> int:
             return 0
         if time.monotonic() - last_hb > 1.0:
             emit({"event": "heartbeat"})
+            from arroyo_tpu.metrics import registry as _mreg
+
+            emit({"event": "metrics", "data": _mreg.job_metrics(args.job_id)})
             last_hb = time.monotonic()
         time.sleep(0.05)
 

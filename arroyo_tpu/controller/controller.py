@@ -41,6 +41,9 @@ class JobController:
         self.running_since: Optional[float] = None
         self.stopping_epoch: Optional[int] = None
         self.failure: Optional[str] = None
+        from ..metrics import RateTracker
+
+        self.rates = RateTracker(window_s=10.0)
 
     # ------------------------------------------------------------------
 
@@ -143,6 +146,16 @@ class JobController:
             kind = ev.get("event")
             if kind == "sink_data":
                 self.db.record_output(self.job_id, ev.get("lines", []))
+            elif kind == "metrics":
+                data = ev.get("data") or {}
+                now = time.monotonic()
+                for op, m in data.items():
+                    self.rates.observe(
+                        f"{op}.sent", int(m.get("arroyo_worker_messages_sent", 0)), now
+                    )
+                    m["messages_per_sec"] = round(self.rates.rate(f"{op}.sent"), 2)
+                if data:
+                    self.db.record_metrics(self.job_id, data)
             elif kind == "checkpoint_completed":
                 epoch = int(ev["epoch"])
                 self.db.record_checkpoint(self.job_id, epoch, "complete")
@@ -237,6 +250,14 @@ class ControllerServer:
                 )
         for jid, jc in list(self.jobs.items()):
             if jc.is_terminal():
+                # persist a final snapshot, then free the process-global
+                # registry (it would otherwise grow per finished job)
+                from ..metrics import registry as metrics_registry
+
+                final = metrics_registry.job_metrics(jid)
+                if final:
+                    self.db.record_metrics(jid, final)
+                metrics_registry.clear_job(jid)
                 del self.jobs[jid]
                 continue
             jc.step()

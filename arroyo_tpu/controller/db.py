@@ -49,6 +49,11 @@ CREATE TABLE IF NOT EXISTS job_outputs (
     line TEXT NOT NULL,
     PRIMARY KEY (job_id, seq)
 );
+CREATE TABLE IF NOT EXISTS job_metrics (
+    job_id TEXT PRIMARY KEY,
+    data TEXT NOT NULL,
+    updated_at REAL NOT NULL
+);
 """
 
 _OUTPUT_CAP = 10_000  # preview rows retained per job
@@ -185,6 +190,25 @@ class Database:
                 (job_id, after_seq, limit),
             ).fetchall()
         return [dict(r) for r in rows]
+
+    def record_metrics(self, job_id: str, data: dict) -> None:
+        """Latest per-operator metrics snapshot (workers ship these over
+        the control protocol; reference JobMetrics gRPC + 1s scrape)."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO job_metrics (job_id, data, updated_at) VALUES (?,?,?) "
+                "ON CONFLICT(job_id) DO UPDATE SET data=excluded.data, "
+                "updated_at=excluded.updated_at",
+                (job_id, json.dumps(data), time.time()),
+            )
+            self._conn.commit()
+
+    def get_metrics(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT data FROM job_metrics WHERE job_id=?", (job_id,)
+            ).fetchone()
+        return json.loads(row["data"]) if row else None
 
     def close(self) -> None:
         with self._lock:

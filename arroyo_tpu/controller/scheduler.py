@@ -96,6 +96,14 @@ class EmbeddedWorkerHandle(WorkerHandle):
         lines = take_preview_rows(self.engine.job_id)
         if lines:
             self._events.put({"event": "sink_data", "lines": lines})
+        now = time.monotonic()
+        if now - getattr(self, "_last_metrics", 0.0) >= 1.0:
+            self._last_metrics = now
+            from ..metrics import registry as _mreg
+
+            self._events.put({
+                "event": "metrics", "data": _mreg.job_metrics(self.engine.job_id)
+            })
 
     def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
         self.engine.trigger_checkpoint(epoch, then_stop=then_stop)

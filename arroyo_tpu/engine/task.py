@@ -101,6 +101,16 @@ class Task:
         self.control_queue: "_queue.Queue[ControlMessage]" = _queue.Queue()
         self.thread: Optional[threading.Thread] = None
         self.is_source = isinstance(operator, SourceOperator)
+        from ..metrics import registry as _metrics_registry
+
+        self.metrics = _metrics_registry.task(
+            task_info.job_id, task_info.node_id, task_info.subtask_index
+        )
+        if inbox is not None:
+            self.metrics.queue_size = inbox.row_budget * inbox.n_inputs
+            # an idle queue is an EMPTY queue, not a full one
+            self.metrics.queue_rem = self.metrics.queue_size
+        collector.metrics = self.metrics
 
     # ------------------------------------------------------------------ API
 
@@ -254,8 +264,12 @@ class Task:
                 continue
 
             if isinstance(item, Batch):
+                self.metrics.add("arroyo_worker_batches_recv")
+                self.metrics.add("arroyo_worker_messages_recv", item.num_rows)
+                self.metrics.add("arroyo_worker_bytes_recv", item.nbytes())
                 op.process_batch(item, self.ctx, self.collector, input_index=idx)
                 self.inbox.release(idx, item)
+                self.metrics.queue_rem = self.metrics.queue_size - self.inbox.used_rows()
                 continue
 
             sig: Signal = item

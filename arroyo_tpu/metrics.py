@@ -1,0 +1,141 @@
+"""Task metrics: counters + gauges with prometheus text exposition.
+
+Reference: crates/arroyo-metrics/src/lib.rs — TaskCounters (:91:
+arroyo_worker_{messages,batches,bytes}_{recv,sent}, deserialization errors)
+and TX-queue gauges (:161-163); scraped via the admin server's /metrics and
+aggregated controller-side into rates + backpressure
+(job_controller/job_metrics.rs:63-130, backpressure = 1 - rem/size :95).
+No prometheus client dependency — the text format is trivial.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+_COUNTER_NAMES = (
+    "arroyo_worker_messages_recv",
+    "arroyo_worker_messages_sent",
+    "arroyo_worker_batches_recv",
+    "arroyo_worker_batches_sent",
+    "arroyo_worker_bytes_recv",
+    "arroyo_worker_bytes_sent",
+    "arroyo_worker_deserialization_errors",
+)
+
+
+class TaskMetrics:
+    """Per-subtask counters (lock-free: single writer per task thread)."""
+
+    __slots__ = ("job_id", "node_id", "subtask", "counters", "queue_size",
+                 "queue_rem")
+
+    def __init__(self, job_id: str, node_id: str, subtask: int):
+        self.job_id = job_id
+        self.node_id = node_id
+        self.subtask = subtask
+        self.counters = dict.fromkeys(_COUNTER_NAMES, 0)
+        self.queue_size = 0
+        self.queue_rem = 0
+
+    def add(self, name: str, v: int = 1) -> None:
+        self.counters[name] += v
+
+    def backpressure(self) -> float:
+        """1 - queue_remaining/queue_size (reference job_metrics.rs:95)."""
+        if self.queue_size <= 0:
+            return 0.0
+        return max(0.0, 1.0 - self.queue_rem / self.queue_size)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tasks: dict[tuple[str, str, int], TaskMetrics] = {}
+
+    def task(self, job_id: str, node_id: str, subtask: int) -> TaskMetrics:
+        key = (job_id, node_id, subtask)
+        with self._lock:
+            tm = self._tasks.get(key)
+            if tm is None:
+                tm = TaskMetrics(job_id, node_id, subtask)
+                self._tasks[key] = tm
+            return tm
+
+    def snapshot(self) -> list[TaskMetrics]:
+        with self._lock:
+            return list(self._tasks.values())
+
+    def clear_job(self, job_id: str) -> None:
+        with self._lock:
+            self._tasks = {
+                k: v for k, v in self._tasks.items() if k[0] != job_id
+            }
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (served at /metrics)."""
+        lines: list[str] = []
+        tasks = self.snapshot()
+        for name in _COUNTER_NAMES:
+            lines.append(f"# TYPE {name} counter")
+            for t in tasks:
+                lines.append(
+                    f'{name}{{job="{t.job_id}",operator="{t.node_id}",'
+                    f'subtask="{t.subtask}"}} {t.counters[name]}'
+                )
+        lines.append("# TYPE arroyo_worker_tx_queue_size gauge")
+        lines.append("# TYPE arroyo_worker_tx_queue_rem gauge")
+        for t in tasks:
+            label = (f'job="{t.job_id}",operator="{t.node_id}",'
+                     f'subtask="{t.subtask}"')
+            lines.append(f"arroyo_worker_tx_queue_size{{{label}}} {t.queue_size}")
+            lines.append(f"arroyo_worker_tx_queue_rem{{{label}}} {t.queue_rem}")
+        return "\n".join(lines) + "\n"
+
+    def job_metrics(self, job_id: str) -> dict:
+        """Per-operator aggregates for the API
+        (reference /operator_metric_groups)."""
+        out: dict[str, dict] = {}
+        for t in self.snapshot():
+            if t.job_id != job_id:
+                continue
+            op = out.setdefault(t.node_id, {
+                "subtasks": 0,
+                **dict.fromkeys(_COUNTER_NAMES, 0),
+                "backpressure": 0.0,
+            })
+            op["subtasks"] += 1
+            for name in _COUNTER_NAMES:
+                op[name] += t.counters[name]
+            op["backpressure"] = max(op["backpressure"], t.backpressure())
+        return out
+
+
+registry = MetricsRegistry()
+
+
+class RateTracker:
+    """Windowed rate computation (reference job_metrics.rs rate windows)."""
+
+    def __init__(self, window_s: float = 10.0):
+        self.window_s = window_s
+        self._points: dict[str, list[tuple[float, int]]] = defaultdict(list)
+
+    def observe(self, key: str, value: int, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        pts = self._points[key]
+        pts.append((now, value))
+        cutoff = now - self.window_s
+        while len(pts) > 2 and pts[0][0] < cutoff:
+            pts.pop(0)
+
+    def rate(self, key: str) -> float:
+        pts = self._points.get(key)
+        if not pts or len(pts) < 2:
+            return 0.0
+        (t0, v0), (t1, v1) = pts[0], pts[-1]
+        if t1 <= t0:
+            return 0.0
+        return (v1 - v0) / (t1 - t0)

@@ -44,12 +44,17 @@ class Collector:
         self._rr_offset = random.randrange(1 << 16)
         self.batches_sent = 0
         self.rows_sent = 0
+        self.metrics = None  # TaskMetrics, attached by the owning Task
 
     def collect(self, batch: Batch) -> None:
         if batch.num_rows == 0:
             return
         self.batches_sent += 1
         self.rows_sent += batch.num_rows
+        if self.metrics is not None:
+            self.metrics.add("arroyo_worker_batches_sent")
+            self.metrics.add("arroyo_worker_messages_sent", batch.num_rows)
+            self.metrics.add("arroyo_worker_bytes_sent", batch.nbytes())
         for edge in self.out_edges:
             n = len(edge.dests)
             if n == 1:

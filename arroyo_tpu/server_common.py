@@ -1,0 +1,123 @@
+"""Service plumbing: logging init + admin HTTP server.
+
+Reference: crates/arroyo-server-common/src/lib.rs — init_logging (:53,
+json/logfmt/console formats from the [logging] config section) and the
+per-service admin HTTP server (:280, default port 5114) exposing /metrics,
+/status, /config (heap profiling is jemalloc-specific and has no analog
+here).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_START_TIME = time.time()
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": self.formatTime(record, "%Y-%m-%dT%H:%M:%S"),
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out, separators=(",", ":"))
+
+
+class _LogfmtFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = record.getMessage().replace('"', '\\"')
+        return (
+            f'ts={self.formatTime(record, "%Y-%m-%dT%H:%M:%S")} '
+            f'level={record.levelname.lower()} target={record.name} '
+            f'msg="{msg}"'
+        )
+
+
+def init_logging(fmt: Optional[str] = None, level: Optional[str] = None) -> None:
+    """fmt: console | json | logfmt (config [logging] section analog)."""
+    from .config import config
+
+    fmt = fmt or config().get("logging.format", "console")
+    level = level or config().get("logging.level", "INFO")
+    root = logging.getLogger()
+    root.setLevel(getattr(logging, str(level).upper(), logging.INFO))
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler = logging.StreamHandler(sys.stderr)
+    if fmt == "json":
+        handler.setFormatter(_JsonFormatter())
+    elif fmt == "logfmt":
+        handler.setFormatter(_LogfmtFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)-7s %(name)s: %(message)s", "%H:%M:%S"
+        ))
+    root.addHandler(handler)
+
+
+class AdminServer:
+    """Per-process admin endpoint: /metrics (prometheus), /status, /config."""
+
+    def __init__(self, service: str, port: int = 0, host: str = "127.0.0.1"):
+        self.service = service
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    from .metrics import registry
+
+                    body = registry.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif path == "/status":
+                    body = json.dumps({
+                        "service": outer.service,
+                        "uptime_s": round(time.time() - _START_TIME, 1),
+                        "healthy": True,
+                    }).encode()
+                    ctype = "application/json"
+                elif path == "/config":
+                    from .config import config
+
+                    body = json.dumps(config()._data, default=str).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "AdminServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="admin-server"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)

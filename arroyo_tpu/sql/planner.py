@@ -408,6 +408,7 @@ class Planner:
         return f"_col_{i}"
 
     def _plan_projection(self, rel: Rel, q: Select) -> Rel:
+        rel, q = self._plan_async_udfs(rel, q)
         pairs = self._expand_items(q.items, rel.scope)
         proj: list[tuple[str, Expr]] = []
         dtypes: dict[str, str] = {}
@@ -443,6 +444,56 @@ class Planner:
         # rel.window (the branch's windowing trait) carries through a
         # projection even when the window struct columns are dropped
         return Rel(vid, dtypes, out_scope, rel.updating, rel.window, rel.keyed)
+
+    def _plan_async_udfs(self, rel: Rel, q: Select):
+        """Select items calling async Python UDFs get their own dataflow
+        node (reference AsyncUdfRewriter, rewriters.rs): bounded-concurrency
+        out-of-band compute, results re-joined positionally."""
+        from ..udf import lookup_udf
+
+        async_calls: list[tuple[str, object, object]] = []  # (out, call, udf)
+        for i, it in enumerate(q.items):
+            if isinstance(it.expr, FuncCall):
+                udf = lookup_udf(it.expr.name)
+                if udf is not None and udf.is_async:
+                    async_calls.append((self._item_name(it, i), it.expr, udf))
+        if not async_calls:
+            return rel, q
+        # pre-filter applies before the async hop (rows dropped early)
+        if q.where is not None:
+            filt = compile_expr(q.where, rel.scope)
+            vid = self._id("value", "pre_async")
+            self._add_node(vid, OpName.VALUE, {"projections": None, "filter": filt})
+            self._edge(rel, vid, EdgeType.FORWARD, rel.schema())
+            rel = Rel(vid, rel.dtypes, rel.scope, rel.updating, rel.window, rel.keyed)
+            q = Select(q.items, q.from_table, q.joins, None, q.group_by,
+                       q.having, q.order_by, q.limit, q.distinct)
+        rewrites: list[tuple[SqlExpr, SqlExpr]] = []
+        for out_name, call, udf in async_calls:
+            args = tuple(compile_expr(a, rel.scope) for a in call.args)
+            aid = self._id("async_udf", udf.name)
+            self._add_node(aid, OpName.ASYNC_UDF, {
+                "name": udf.name, "fn": udf.fn, "arg_exprs": list(args),
+                "out_name": out_name, "return_dtype": udf.return_dtype,
+                "ordered": udf.ordered, "max_concurrency": udf.max_concurrency,
+            })
+            self._edge(rel, aid, EdgeType.FORWARD, rel.schema())
+            dt = dict(rel.dtypes)
+            dt[out_name] = udf.return_dtype
+            scope = Scope()
+            for qq, n, k, p in rel.scope._order:
+                if k == "col":
+                    scope.add_col(qq, n, p)
+                else:
+                    scope.add_window(qq, n, p)
+            scope.add_col(None, out_name, out_name)
+            rel = Rel(aid, dt, scope, rel.updating, rel.window, rel.keyed)
+            rewrites.append((call, Ident(out_name)))
+        items = [SelectItem(replace_nodes(it.expr, rewrites), it.alias)
+                 for it in q.items]
+        q = Select(items, q.from_table, q.joins, q.where, q.group_by,
+                   q.having, q.order_by, q.limit, q.distinct)
+        return rel, q
 
     # ------------------------------------------------------------ aggregate
 

@@ -131,21 +131,28 @@ def test_task_failure_aborts_pipeline_promptly():
         def process_batch(self, batch, ctx, collector, input_index=0):
             raise RuntimeError("boom in operator")
 
+    from arroyo_tpu.engine import engine as engine_mod
+
+    saved = engine_mod._CONSTRUCTORS.get(OpName.ASYNC_UDF)
     register_operator(OpName.ASYNC_UDF)(lambda cfg: Exploder())
+    try:
+        g = Graph()
+        g.add_node(Node("src", OpName.SOURCE,
+                        {"connector": "impulse", "message_count": None, "event_rate": 50000}, 1))
+        g.add_node(Node("bad", OpName.ASYNC_UDF, {}, 1))
+        g.add_edge("src", "bad", EdgeType.FORWARD, DUMMY)
+        eng = Engine(g, job_id="fail")
+        eng.start()
+        t0 = time.monotonic()
+        import pytest as _pytest
 
-    g = Graph()
-    g.add_node(Node("src", OpName.SOURCE,
-                    {"connector": "impulse", "message_count": None, "event_rate": 50000}, 1))
-    g.add_node(Node("bad", OpName.ASYNC_UDF, {}, 1))
-    g.add_edge("src", "bad", EdgeType.FORWARD, DUMMY)
-    eng = Engine(g, job_id="fail")
-    eng.start()
-    t0 = time.monotonic()
-    import pytest as _pytest
-
-    with _pytest.raises(RuntimeError, match="boom in operator"):
-        eng.join(timeout=30)
-    assert time.monotonic() - t0 < 15  # aborted promptly, not via timeout
+        with _pytest.raises(RuntimeError, match="boom in operator"):
+            eng.join(timeout=30)
+        assert time.monotonic() - t0 < 15  # aborted promptly, not via timeout
+    finally:
+        # restore the real async-udf constructor (the registry is global)
+        if saved is not None:
+            engine_mod._CONSTRUCTORS[OpName.ASYNC_UDF] = saved
 
 
 def test_backpressure_bounded_queue():

@@ -1,0 +1,158 @@
+"""Metrics, admin server, logging, async UDFs.
+
+Reference: arroyo-metrics (TaskCounters), arroyo-server-common (admin
+server, init_logging), arroyo-worker/src/arrow/async_udf.rs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import arroyo_tpu
+from arroyo_tpu.batch import TIMESTAMP_FIELD, Schema
+from arroyo_tpu.metrics import RateTracker, registry
+from arroyo_tpu.udf import drop_udf, register_udf
+
+
+def _run_simple_pipeline(tmp_path, job_id):
+    from arroyo_tpu.engine.engine import run_graph
+    from arroyo_tpu.graph import EdgeType, Graph, Node, OpName
+
+    arroyo_tpu._load_operators()
+    src = tmp_path / "in.json"
+    with open(src, "w") as f:
+        for i in range(100):
+            f.write(json.dumps({"x": i, "_timestamp": i}) + "\n")
+    S = Schema.of([("x", "int64"), (TIMESTAMP_FIELD, "int64")])
+    rows = []
+    g = Graph()
+    g.add_node(Node("src", OpName.SOURCE, {
+        "connector": "single_file", "path": str(src), "schema": S}, 1))
+    g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": rows}, 1))
+    g.add_edge("src", "sink", EdgeType.FORWARD, S)
+    run_graph(g, job_id=job_id, timeout=60)
+    return rows
+
+
+def test_task_counters_and_prometheus(tmp_path, _storage):
+    registry.clear_job("metrics-job")
+    rows = _run_simple_pipeline(tmp_path, "metrics-job")
+    assert len(rows) == 100
+    jm = registry.job_metrics("metrics-job")
+    assert jm["src"]["arroyo_worker_messages_sent"] == 100
+    assert jm["sink"]["arroyo_worker_messages_recv"] == 100
+    assert jm["sink"]["arroyo_worker_bytes_recv"] > 0
+    text = registry.prometheus_text()
+    assert 'arroyo_worker_messages_sent{job="metrics-job",operator="src"' in text
+    assert "# TYPE arroyo_worker_messages_recv counter" in text
+
+
+def test_admin_server(tmp_path, _storage):
+    from arroyo_tpu.server_common import AdminServer
+
+    registry.clear_job("admin-job")
+    _run_simple_pipeline(tmp_path, "admin-job")
+    srv = AdminServer("worker", port=0).start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/status") as r:
+            status = json.loads(r.read())
+        assert status["healthy"] and status["service"] == "worker"
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics") as r:
+            text = r.read().decode()
+        assert "arroyo_worker_batches_sent" in text
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/config") as r:
+            conf = json.loads(r.read())
+        assert "pipeline" in conf
+    finally:
+        srv.stop()
+
+
+def test_init_logging_formats(capsys):
+    from arroyo_tpu.server_common import init_logging
+
+    for fmt in ("console", "json", "logfmt"):
+        init_logging(fmt=fmt, level="INFO")
+        logging.getLogger("arroyo.test").info("hello %s", fmt)
+        err = capsys.readouterr().err
+        assert "hello" in err
+        if fmt == "json":
+            assert json.loads(err.strip())["message"] == "hello json"
+    # restore default handlers
+    logging.getLogger().handlers.clear()
+
+
+def test_rate_tracker():
+    rt = RateTracker(window_s=10)
+    rt.observe("k", 0, now=0.0)
+    rt.observe("k", 500, now=5.0)
+    assert rt.rate("k") == pytest.approx(100.0)
+    assert rt.rate("missing") == 0.0
+
+
+@pytest.mark.parametrize("ordered", [True, False])
+def test_async_udf_sql(ordered, tmp_path, _storage):
+    from arroyo_tpu.engine.engine import run_graph
+    from arroyo_tpu.sql import plan_query
+
+    arroyo_tpu._load_operators()
+    name = f"audf_{'o' if ordered else 'u'}"
+
+    @register_udf(name, return_dtype="int64", is_async=True,
+                  max_concurrency=8, ordered=ordered)
+    def _double(x):
+        time.sleep(0.001)
+        return int(x) * 2
+
+    try:
+        src = tmp_path / "in.json"
+        with open(src, "w") as f:
+            for i in range(60):
+                f.write(json.dumps({"x": i, "_timestamp": i}) + "\n")
+        sql = f"""
+        CREATE TABLE t (x BIGINT) WITH (connector='single_file',
+          path='{src}', format='json', type='source');
+        SELECT x, {name}(x) AS dbl FROM t WHERE x % 3 = 0;
+        """
+        pp = plan_query(sql)
+        ops = [n.op.value for n in pp.graph.topo_order()]
+        assert "async_udf" in ops
+        run_graph(pp.graph, job_id=f"audf-{ordered}", timeout=60)
+        rows = sorted(pp.sinks[0].rows, key=lambda r: r["x"])
+        assert [(r["x"], r["dbl"]) for r in rows] == [
+            (i, i * 2) for i in range(0, 60, 3)
+        ]
+    finally:
+        drop_udf(name)
+
+
+def test_scalar_udf_sql(tmp_path, _storage):
+    from arroyo_tpu.engine.engine import run_graph
+    from arroyo_tpu.sql import plan_query
+
+    arroyo_tpu._load_operators()
+
+    @register_udf("triple", return_dtype="int64", vectorized=True)
+    def _triple(x):
+        return x * 3
+
+    try:
+        src = tmp_path / "in.json"
+        with open(src, "w") as f:
+            for i in range(10):
+                f.write(json.dumps({"x": i, "_timestamp": i}) + "\n")
+        sql = f"""
+        CREATE TABLE t (x BIGINT) WITH (connector='single_file',
+          path='{src}', format='json', type='source');
+        SELECT triple(x) AS t3 FROM t;
+        """
+        pp = plan_query(sql)
+        run_graph(pp.graph, job_id="sudf", timeout=60)
+        assert sorted(r["t3"] for r in pp.sinks[0].rows) == [i * 3 for i in range(10)]
+    finally:
+        drop_udf("triple")
