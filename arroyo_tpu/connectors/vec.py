@@ -10,11 +10,14 @@ from . import register_sink
 
 class VecSink(Operator):
     """config: rows: list (shared, appended under a lock),
-    include_internal: bool (keep _timestamp/_key columns)."""
+    include_internal: bool (keep _timestamp/_key columns),
+    columnar: bool (append Batch objects instead of row dicts — no
+    per-row materialization cost; used by bench.py)."""
 
     def __init__(self, cfg: dict):
         self.rows: list = cfg["rows"]
         self.include_internal = cfg.get("include_internal", False)
+        self.columnar = cfg.get("columnar", False)
         self._lock = cfg.setdefault("_lock", threading.Lock())
 
     def process_batch(self, batch, ctx, collector, input_index=0):
@@ -24,7 +27,10 @@ class VecSink(Operator):
             if drop:
                 out = batch.without_columns(drop)
         with self._lock:
-            self.rows.extend(out.to_pylist())
+            if self.columnar:
+                self.rows.append(out)
+            else:
+                self.rows.extend(out.to_pylist())
 
 
 register_sink("vec")(VecSink)

@@ -71,18 +71,21 @@ class KeyDictionary:
         if not self.key_fields:
             return
         u, first = np.unique(hashes, return_index=True)
-        cols = [batch[f] for f in self.key_fields]
-        # max bin per unique hash: sort once, take per-group maxima
-        order = np.argsort(hashes, kind="stable")
-        sorted_bins = np.maximum.reduceat(
-            np.asarray(bins)[order], np.searchsorted(hashes[order], u)
-        )
-        for h, i, b in zip(u.tolist(), first.tolist(), sorted_bins.tolist()):
-            if h not in self.values:
+        u_list = u.tolist()
+        # conservative liveness: every key seen in this batch is treated as
+        # live through the batch's max bin. dict.fromkeys + update run at C
+        # speed; per-key exact maxima would cost a Python loop per batch and
+        # only evict (at most) one batch's bin-spread earlier.
+        mx = int(bins.max()) if len(bins) else 0
+        lb = self.last_bin
+        lb.update({h: mx for h in u_list if lb.get(h, -1) < mx})
+        new = [h for h in u_list if h not in self.values]
+        if new:
+            cols = [batch[f] for f in self.key_fields]
+            idx_of = dict(zip(u_list, first.tolist()))
+            for h in new:
+                i = idx_of[h]
                 self.values[h] = tuple(c[i] for c in cols)
-                self.last_bin[h] = int(b)
-            elif b > self.last_bin[h]:
-                self.last_bin[h] = int(b)
 
     def evict_closed(self, rel_before: int) -> None:
         dead = [h for h, b in self.last_bin.items() if b < rel_before]
