@@ -29,5 +29,5 @@ def _load_operators() -> None:
     from .operators import builtin  # noqa: F401
 
     connectors.load_all()
-    from .operators import async_udf, joins, updating_aggregate, window_fn  # noqa: F401
+    from .operators import async_udf, chained, joins, updating_aggregate, window_fn  # noqa: F401
     from .windows import session, sliding, tumbling  # noqa: F401

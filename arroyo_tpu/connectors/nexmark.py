@@ -95,20 +95,30 @@ class NexmarkSource(SourceOperator):
         else:
             self.inter_event_micros = 1000
         self.include_strings = cfg.get("include_strings", True)
+        # projection pushdown: planner-provided set of columns the query
+        # reads (presence flags + timestamp always generated); None = all
+        self.columns = set(cfg["columns"]) if cfg.get("columns") else None
 
     def tables(self):
         return [TableSpec("s", "global_keyed")]
 
     def _generate(self, numbers: np.ndarray) -> Batch:
-        """Vectorized event synthesis for the given absolute event numbers."""
+        """Vectorized event synthesis for the given absolute event numbers.
+
+        ``self.columns`` (planner projection pushdown, like DataFusion's
+        projection pushdown into table scans) restricts synthesis to the
+        columns a query actually reads; presence flags and the timestamp are
+        always produced."""
         n = numbers.astype(np.uint64)
         count = len(n)
+        need = self.columns  # None = all
+        def want(c):
+            return need is None or c in need
         epoch = (n // np.uint64(TOTAL_PROPORTION)).astype(np.int64)
         offset = (n % np.uint64(TOTAL_PROPORTION)).astype(np.int64)
         is_person = offset < PERSON_PROPORTION
         is_auction = (~is_person) & (offset < PERSON_PROPORTION + AUCTION_PROPORTION)
         is_bid = ~(is_person | is_auction)
-        event_type = np.where(is_person, 0, np.where(is_auction, 1, 2)).astype(np.int32)
         ts = self.first_event_micros + n.astype(np.int64) * self.inter_event_micros
 
         # ids so far (exclusive of current epoch, conservative "active" sets)
@@ -117,60 +127,85 @@ class NexmarkSource(SourceOperator):
 
         r0 = _rng(n, 1)
         r1 = _rng(n, 2)
-        r2 = _rng(n, 3)
-        r3 = _rng(n, 4)
 
-        person_id = np.where(is_person, FIRST_PERSON_ID + epoch, 0).astype(np.int64)
-        auction_id = np.where(
-            is_auction, FIRST_AUCTION_ID + epoch * AUCTION_PROPORTION + (offset - PERSON_PROPORTION), 0
-        ).astype(np.int64)
-
-        # bids: hot auctions/bidders with ratio 1/HOT of uniform traffic
-        recent_window = np.maximum(max_auction - FIRST_AUCTION_ID, 1)
-        hot_auction = np.maximum(max_auction - 1 - (r0 % np.uint64(HOT_AUCTION_RATIO)).astype(np.int64), FIRST_AUCTION_ID)
-        cold_auction = FIRST_AUCTION_ID + (r0.astype(np.int64) % recent_window)
-        bid_auction = np.where(
-            (r1 % np.uint64(100)).astype(np.int64) < 90, hot_auction, cold_auction
-        )
-        recent_people = np.maximum(max_person - FIRST_PERSON_ID, 1)
-        hot_bidder = np.maximum(max_person - 1 - (r2 % np.uint64(HOT_BIDDER_RATIO)).astype(np.int64), FIRST_PERSON_ID)
-        cold_bidder = FIRST_PERSON_ID + (r2.astype(np.int64) % recent_people)
-        bid_bidder = np.where((r3 % np.uint64(100)).astype(np.int64) < 90, hot_bidder, cold_bidder)
-        price = (100 + (r1 % np.uint64(9_999_900))).astype(np.int64)
+        auction_id = None
+        if want("auction.id") or want("auction.item_name"):
+            auction_id = np.where(
+                is_auction, FIRST_AUCTION_ID + epoch * AUCTION_PROPORTION + (offset - PERSON_PROPORTION), 0
+            ).astype(np.int64)
 
         cols: dict[str, np.ndarray] = {
-            "event_type": event_type,
             "person": is_person,
-            "person.id": person_id,
             "auction": is_auction,
-            "auction.id": auction_id,
-            "auction.initial_bid": np.where(is_auction, 100 + (r1 % np.uint64(1000)).astype(np.int64), 0),
-            "auction.reserve": np.where(is_auction, 500 + (r2 % np.uint64(2000)).astype(np.int64), 0),
-            "auction.expires": np.where(is_auction, ts + (1 + (r3 % np.uint64(60))).astype(np.int64) * 1_000_000, 0),
-            "auction.seller": np.where(
-                is_auction, FIRST_PERSON_ID + (r0.astype(np.int64) % np.maximum(max_person - FIRST_PERSON_ID, 1)), 0
-            ),
-            "auction.category": np.where(is_auction, FIRST_CATEGORY_ID + (r0.astype(np.int64) % 5), 0),
             "bid": is_bid,
-            "bid.auction": np.where(is_bid, bid_auction, 0),
-            "bid.bidder": np.where(is_bid, bid_bidder, 0),
-            "bid.price": np.where(is_bid, price, 0),
-            "bid.datetime": np.where(is_bid, ts // 1000, 0),
             TIMESTAMP_FIELD: ts,
         }
+        if want("event_type"):
+            cols["event_type"] = np.where(is_person, 0, np.where(is_auction, 1, 2)).astype(np.int32)
+        if want("person.id"):
+            cols["person.id"] = np.where(is_person, FIRST_PERSON_ID + epoch, 0).astype(np.int64)
+        if auction_id is not None:
+            cols["auction.id"] = auction_id
+        if want("bid.auction"):
+            # bids: hot auctions with ratio 1/HOT of uniform traffic
+            recent_window = np.maximum(max_auction - FIRST_AUCTION_ID, 1)
+            hot_auction = np.maximum(
+                max_auction - 1 - (r0 % np.uint64(HOT_AUCTION_RATIO)).astype(np.int64), FIRST_AUCTION_ID)
+            cold_auction = FIRST_AUCTION_ID + (r0.astype(np.int64) % recent_window)
+            cols["bid.auction"] = np.where(
+                is_bid,
+                np.where((r1 % np.uint64(100)).astype(np.int64) < 90, hot_auction, cold_auction),
+                0,
+            )
+        if want("bid.bidder"):
+            r2 = _rng(n, 3)
+            r3 = _rng(n, 4)
+            recent_people = np.maximum(max_person - FIRST_PERSON_ID, 1)
+            hot_bidder = np.maximum(
+                max_person - 1 - (r2 % np.uint64(HOT_BIDDER_RATIO)).astype(np.int64), FIRST_PERSON_ID)
+            cold_bidder = FIRST_PERSON_ID + (r2.astype(np.int64) % recent_people)
+            cols["bid.bidder"] = np.where(
+                is_bid,
+                np.where((r3 % np.uint64(100)).astype(np.int64) < 90, hot_bidder, cold_bidder),
+                0,
+            )
+        if want("bid.price"):
+            cols["bid.price"] = np.where(is_bid, (100 + (r1 % np.uint64(9_999_900))).astype(np.int64), 0)
+        if want("auction.initial_bid"):
+            cols["auction.initial_bid"] = np.where(is_auction, 100 + (r1 % np.uint64(1000)).astype(np.int64), 0)
+        if want("auction.reserve"):
+            cols["auction.reserve"] = np.where(is_auction, 500 + (_rng(n, 3) % np.uint64(2000)).astype(np.int64), 0)
+        if want("auction.expires"):
+            cols["auction.expires"] = np.where(
+                is_auction, ts + (1 + (_rng(n, 4) % np.uint64(60))).astype(np.int64) * 1_000_000, 0)
+        if want("auction.seller"):
+            cols["auction.seller"] = np.where(
+                is_auction, FIRST_PERSON_ID + (r0.astype(np.int64) % np.maximum(max_person - FIRST_PERSON_ID, 1)), 0
+            )
+        if want("auction.category"):
+            cols["auction.category"] = np.where(is_auction, FIRST_CATEGORY_ID + (r0.astype(np.int64) % 5), 0)
+        if want("bid.datetime"):
+            cols["bid.datetime"] = np.where(is_bid, ts // 1000, 0)
         if self.include_strings:
-            cols["person.name"] = np.where(
-                is_person, np.char.add("person-", epoch.astype(str)).astype(object), None
-            )
-            cols["person.email_address"] = np.where(
-                is_person, np.char.add(np.char.add("p", epoch.astype(str)), "@example.com").astype(object), None
-            )
-            cols["person.city"] = np.where(is_person, _CITIES[(r1 % np.uint64(len(_CITIES))).astype(np.int64)], None)
-            cols["person.state"] = np.where(is_person, _US_STATES[(r2 % np.uint64(len(_US_STATES))).astype(np.int64)], None)
-            cols["auction.item_name"] = np.where(
-                is_auction, np.char.add("item-", auction_id.astype(str)).astype(object), None
-            )
-            cols["bid.channel"] = np.where(is_bid, _CHANNELS[(r2 % np.uint64(len(_CHANNELS))).astype(np.int64)], None)
+            r2s = _rng(n, 3)
+            if want("person.name"):
+                cols["person.name"] = np.where(
+                    is_person, np.char.add("person-", epoch.astype(str)).astype(object), None
+                )
+            if want("person.email_address"):
+                cols["person.email_address"] = np.where(
+                    is_person, np.char.add(np.char.add("p", epoch.astype(str)), "@example.com").astype(object), None
+                )
+            if want("person.city"):
+                cols["person.city"] = np.where(is_person, _CITIES[(r1 % np.uint64(len(_CITIES))).astype(np.int64)], None)
+            if want("person.state"):
+                cols["person.state"] = np.where(is_person, _US_STATES[(r2s % np.uint64(len(_US_STATES))).astype(np.int64)], None)
+            if want("auction.item_name"):
+                cols["auction.item_name"] = np.where(
+                    is_auction, np.char.add("item-", auction_id.astype(str)).astype(object), None
+                )
+            if want("bid.channel"):
+                cols["bid.channel"] = np.where(is_bid, _CHANNELS[(r2s % np.uint64(len(_CHANNELS))).astype(np.int64)], None)
         return Batch(cols)
 
     def run(self, sctx, collector) -> SourceFinishType:

@@ -71,6 +71,10 @@ class Engine:
         on workers (reference compute_assignments, states/scheduling.rs:56);
         None runs everything in this engine. Remote edges ride ``network``
         (engine.network.NetworkManager over the C++ data plane)."""
+        if config().get("pipeline.chaining.enabled"):
+            from ..optimizer import chain_graph
+
+            graph = chain_graph(graph)
         self.graph = graph
         self.job_id = job_id
         self.storage_url = storage_url or config().get("checkpoint.storage-url")

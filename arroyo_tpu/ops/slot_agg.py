@@ -1,0 +1,564 @@
+"""Slot-directory windowed aggregation: scatter-only device path.
+
+Round-1's device hash table probed (bin, key) pairs ON DEVICE with a
+fori_loop of gather rounds. Measured on TPU (v5e over the driver tunnel),
+dynamic gathers are the one slow XLA primitive (~13 ms per 8k-from-64k
+gather) while scatters with combiners run in ~0.03 ms — so a probing hash
+table is the worst possible design for this hardware, and the 2.2%-of-numpy
+round-1 bench (VERDICT.md "What's weak" #1) was almost entirely probe-round
+gathers plus synchronous per-close transfers.
+
+This redesign splits the work by what each side is good at:
+
+  host (vectorized numpy directory; the C++ runtime owns hashing already):
+      (bin, key) -> device slot assignment. Slots live in fixed-size
+      REGIONS; each window bin owns a chain of regions, so a window close
+      maps to contiguous device slices, never a table compaction. The
+      directory is open-addressing over 64-bit mixed codes with monotone
+      bin-boundary liveness (window close is always "bin < boundary", so
+      dead entries need no tombstones).
+
+  device (one jitted step per operator config):
+      state = one [cap] array per accumulator, nothing else in HBM.
+      update = n_acc scatter-combines (.at[slots].add/min/max) — no gather,
+      no sort, no probe loop. Window close = dynamic_slice of the closing
+      bin's regions packed into ONE int64 buffer (single host round trip,
+      fetched asynchronously), plus a dynamic_update_slice clear.
+
+  spill tier: when every region is in use, new (bin, key) groups aggregate
+      into a host dict store instead of erroring — the overflow-to-host
+      policy SURVEY.md hard-part #1 calls for (round 1 raised
+      RuntimeError).
+
+Reference behavior being replaced: the per-bin DataFusion partial
+aggregation plans of crates/arroyo-worker/src/arrow/
+tumbling_aggregating_window.rs:49 and sliding_aggregating_window.rs:45.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..hashing import splitmix64
+from .aggregate import (
+    DeviceHashAggregator,
+    _identity,
+    combine_by_key_bin,
+)
+
+_BIN_MIX = np.uint64(0x9E3779B97F4A7C15)
+_DEAD_BIN = -(2**62)
+
+
+class BinSlotDirectory:
+    """Host-side (bin, key) -> device-slot map with region-chained bins.
+
+    Probing is vectorized numpy over the batch's unique codes: each round
+    gathers one candidate directory row per pending code and resolves
+    match / claim / advance, so cost is O(rounds) numpy passes, not a
+    Python loop per key."""
+
+    def __init__(self, cap: int, region_size: int):
+        assert cap % region_size == 0
+        self.cap = cap
+        self.R = region_size
+        self.n_regions = cap // region_size
+        self.free_regions = list(range(self.n_regions - 1, -1, -1))
+        self.bin_regions: dict[int, list[int]] = {}
+        self.region_fill = np.zeros(self.n_regions, dtype=np.int64)
+        # per-slot identity (for emission: device stores only accumulators)
+        self.slot_keys = np.zeros(cap, dtype=np.int64)
+        self.slot_bins = np.full(cap, _DEAD_BIN, dtype=np.int64)
+        # open-addressing directory: mixed code -> slot
+        self.hcap = 1 << (cap.bit_length() + 1)  # ~4x cap
+        self.hmask = np.uint64(self.hcap - 1)
+        self.hcode = np.zeros(self.hcap, dtype=np.uint64)
+        self.hbin = np.full(self.hcap, _DEAD_BIN, dtype=np.int64)
+        self.hslot = np.full(self.hcap, -1, dtype=np.int64)
+        self.boundary = _DEAD_BIN  # bins below this are closed (monotone)
+
+    # ------------------------------------------------------------- alloc
+
+    def _alloc(self, b: int, n: int) -> np.ndarray:
+        """Up to n device slots for bin b, chaining regions; may return fewer
+        than n when capacity runs out (caller spills the remainder)."""
+        regs = self.bin_regions.get(b)
+        if regs is None:
+            regs = self.bin_regions[b] = []
+        chunks = []
+        while n > 0:
+            if regs and self.region_fill[regs[-1]] < self.R:
+                r = regs[-1]
+                fill = int(self.region_fill[r])
+                take = min(n, self.R - fill)
+                chunks.append(r * self.R + np.arange(fill, fill + take, dtype=np.int64))
+                self.region_fill[r] = fill + take
+                n -= take
+            elif self.free_regions:
+                r = self.free_regions.pop()
+                self.region_fill[r] = 0
+                regs.append(r)
+            else:
+                break
+        if not regs:
+            del self.bin_regions[b]
+        if not chunks:
+            return np.empty(0, dtype=np.int64)
+        return np.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+
+    def live_bins(self) -> list[int]:
+        return sorted(self.bin_regions)
+
+    def close_bin(self, b: int) -> list[int]:
+        """Release bin b's regions for reuse; returns the region ids (the
+        caller must have dispatched the device-side clear first)."""
+        regs = self.bin_regions.pop(b, [])
+        for r in regs:
+            self.free_regions.append(r)
+        return regs
+
+    # ------------------------------------------------------------- lookup
+
+    def lookup_or_assign(
+        self, codes: np.ndarray, keys: np.ndarray, bins: np.ndarray
+    ) -> np.ndarray:
+        """codes: unique uint64 mixed (bin,key) codes; keys/bins: the exact
+        identities behind each code. Returns int64 slots; -1 = spill."""
+        m = len(codes)
+        out = np.full(m, -1, dtype=np.int64)
+        if m == 0:
+            return out
+        h = (codes & self.hmask).astype(np.int64)
+        pending = np.arange(m)
+        spill_blocked = False
+        for _ in range(self.hcap):
+            if len(pending) == 0:
+                break
+            hp = h[pending]
+            cp = codes[pending]
+            hc = self.hcode[hp]
+            live = (self.hslot[hp] >= 0) & (self.hbin[hp] >= self.boundary)
+            match = live & (hc == cp)
+            if match.any():
+                mi = pending[match]
+                s = self.hslot[h[mi]]
+                bad = (self.slot_keys[s] != keys[mi]) | (self.slot_bins[s] != bins[mi])
+                if bad.any():
+                    raise RuntimeError(
+                        "64-bit (bin,key) code collision in slot directory"
+                    )
+                out[mi] = s
+            empty = ~live
+            claim = pending[empty]
+            if len(claim):
+                # claim conflicts within the batch: first code per position
+                # wins, the rest advance and keep probing
+                hcl = h[claim]
+                uniq, first = np.unique(hcl, return_index=True)
+                winners = claim[first]
+                if not spill_blocked:
+                    order = np.argsort(bins[winners], kind="stable")
+                    winners_sorted = winners[order]
+                    wb = bins[winners_sorted]
+                    seg = np.ones(len(wb), dtype=bool)
+                    seg[1:] = wb[1:] != wb[:-1]
+                    starts = np.flatnonzero(seg)
+                    ends = np.append(starts[1:], len(wb))
+                    for s0, s1 in zip(starts, ends):
+                        grp = winners_sorted[s0:s1]
+                        slots = self._alloc(int(wb[s0]), len(grp))
+                        if len(slots) < len(grp):
+                            spill_blocked = True  # unallocated stay -1
+                            grp = grp[: len(slots)]
+                        if len(grp) == 0:
+                            continue
+                        self.slot_keys[slots] = keys[grp]
+                        self.slot_bins[slots] = bins[grp]
+                        pos = h[grp]
+                        self.hcode[pos] = codes[grp]
+                        self.hbin[pos] = bins[grp]
+                        self.hslot[pos] = slots
+                        out[grp] = slots
+            # still pending: not matched and not successfully claimed
+            resolved = out[pending] >= 0
+            give_up = np.zeros(len(pending), dtype=bool)
+            if spill_blocked:
+                give_up = ~resolved & empty  # nothing left to allocate
+            keep = ~resolved & ~give_up
+            nxt = pending[keep]
+            h[nxt] = (h[nxt] + 1) & int(self.hmask)
+            pending = nxt
+        return out
+
+
+class SlotExtractHandle:
+    """In-flight window close: per-region packed buffers are streaming to
+    host; identities (key hash, bin) were snapshotted host-side at dispatch
+    so region reuse can't race the fetch."""
+
+    def __init__(self, agg: "SlotAggregator", groups, spill):
+        self._agg = agg
+        # groups: list of (regs, int_buf|None, float_buf|None) where regs is
+        # [(bin, keys_i64_copy, fill), ...] in buffer order
+        self._groups = groups
+        self._spill = spill  # (keys_u64, bins_i32, [acc arrays]) or None
+
+    def is_ready(self) -> bool:
+        return all(
+            (ib is None or ib.is_ready()) and (fb is None or fb.is_ready())
+            for (_regs, ib, fb) in self._groups
+        )
+
+    def result(self) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
+        agg = self._agg
+        R = agg.region_size
+        int_idx = [i for i, d in enumerate(agg.acc_dtypes)
+                   if not np.issubdtype(d, np.floating)]
+        flt_idx = [i for i, d in enumerate(agg.acc_dtypes)
+                   if np.issubdtype(d, np.floating)]
+        keys_out, bins_out = [], []
+        accs_out: list[list[np.ndarray]] = [[] for _ in agg.acc_dtypes]
+        for regs, ibuf, fbuf in self._groups:
+            # a zero-length fetch still pays a full tunnel round trip, so
+            # absent lane classes are never materialized (buf is None); the
+            # padded tail regions (bases duplicated) are simply not in regs
+            ilanes = flanes = None
+            if ibuf is not None:
+                a = np.asarray(ibuf)
+                ilanes = a.reshape(-1, len(int_idx), R)
+            if fbuf is not None:
+                a = np.asarray(fbuf)
+                flanes = a.reshape(-1, len(flt_idx), R)
+            for pos, (b, keys_i64, fill) in enumerate(regs):
+                if fill == 0:
+                    continue
+                keys_out.append(keys_i64.view(np.uint64))
+                bins_out.append(np.full(fill, b, dtype=np.int32))
+                for j, i in enumerate(int_idx):
+                    accs_out[i].append(ilanes[pos, j, :fill].astype(agg.acc_dtypes[i]))
+                for j, i in enumerate(flt_idx):
+                    accs_out[i].append(flanes[pos, j, :fill].astype(agg.acc_dtypes[i]))
+        if self._spill is not None and len(self._spill[0]):
+            sk, sb, sa = self._spill
+            keys_out.append(sk)
+            bins_out.append(sb)
+            for i, a in enumerate(sa):
+                accs_out[i].append(a)
+        if not keys_out:
+            return (
+                np.empty(0, dtype=np.uint64),
+                np.empty(0, dtype=np.int32),
+                [np.empty(0, dtype=d) for d in agg.acc_dtypes],
+            )
+        return combine_by_key_bin(
+            agg.acc_kinds,
+            np.concatenate(keys_out),
+            np.concatenate(bins_out),
+            [np.concatenate(a) for a in accs_out],
+        )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_slot_jax(acc_kinds: tuple, acc_dtypes: tuple, cap: int, region_size: int):
+    import jax
+    import jax.numpy as jnp
+
+    idents = tuple(
+        np.full(region_size, _identity(k, np.dtype(d)), dtype=d)
+        for k, d in zip(acc_kinds, acc_dtypes)
+    )
+
+    def step(state, slots, vals):
+        out = []
+        for kind, a, v in zip(acc_kinds, state, vals):
+            if kind in ("sum", "count"):
+                out.append(a.at[slots].add(v, mode="drop"))
+            elif kind == "min":
+                out.append(a.at[slots].min(v, mode="drop"))
+            else:
+                out.append(a.at[slots].max(v, mode="drop"))
+        return tuple(out)
+
+    # 64-bit bitcasts are unsupported under TPU x64 emulation, so integer and
+    # float accumulators travel in two separately-typed buffers (still one
+    # fetch each, started together)
+    def _pack(state, base):
+        ilanes, flanes = [], []
+        for a, d in zip(state, acc_dtypes):
+            sl = jax.lax.dynamic_slice(a, (base,), (region_size,))
+            if np.issubdtype(np.dtype(d), np.floating):
+                flanes.append(sl.astype(jnp.float64))
+            else:
+                ilanes.append(sl.astype(jnp.int64))
+        ibuf = jnp.concatenate(ilanes) if ilanes else jnp.zeros(0, jnp.int64)
+        fbuf = jnp.concatenate(flanes) if flanes else jnp.zeros(0, jnp.float64)
+        return ibuf, fbuf
+
+    def _clear(state, base):
+        return tuple(
+            jax.lax.dynamic_update_slice(a, jnp.asarray(i), (base,))
+            for a, i in zip(state, idents)
+        )
+
+    def clear(state, base):
+        return _clear(state, base)
+
+    # multi-region read: one device call + ONE host fetch per window close
+    # regardless of how many bins/regions it spans (each fetch over the
+    # remote-device tunnel costs a full round trip). k is static per jit;
+    # callers bucket k and pad bases by duplicating bases[0] (duplicate
+    # clears are idempotent, duplicate reads are ignored).
+    @functools.lru_cache(maxsize=None)
+    def make_read_multi(k: int, do_clear: bool):
+        def go(state, bases):
+            ibufs, fbufs = [], []
+            for j in range(k):
+                ibuf, fbuf = _pack(state, bases[j])
+                ibufs.append(ibuf)
+                fbufs.append(fbuf)
+            ib = jnp.concatenate(ibufs) if ibufs[0].shape[0] else ibufs[0]
+            fb = jnp.concatenate(fbufs) if fbufs[0].shape[0] else fbufs[0]
+            if do_clear:
+                for j in range(k):
+                    state = _clear(state, bases[j])
+                return state, ib, fb
+            return ib, fb
+
+        if do_clear:
+            return jax.jit(go, donate_argnums=0)
+        return jax.jit(go)
+
+    return (
+        jax.jit(step, donate_argnums=0),
+        make_read_multi,
+        jax.jit(clear, donate_argnums=0),
+    )
+
+
+class SlotAggregator(DeviceHashAggregator):
+    """Drop-in replacement for DeviceHashAggregator (same update / extract /
+    extract_start / scan_range / free_bins_below / snapshot / restore
+    surface) built on the host slot directory + scatter-only device step.
+    backend="numpy" inherits the dict-store oracle unchanged."""
+
+    def __init__(
+        self,
+        acc_kinds: Sequence[str],
+        acc_dtypes: Sequence[np.dtype],
+        cap: int = 65536,
+        batch_cap: int = 8192,
+        max_probes: int = 64,  # unused; kept for constructor compatibility
+        emit_cap: int = 8192,  # unused; region_size bounds each transfer
+        backend: str = "jax",
+        region_size: int = 2048,
+    ):
+        self.region_size = region_size
+        if backend == "jax":
+            self.acc_kinds = tuple(acc_kinds)
+            self.acc_dtypes = tuple(np.dtype(d) for d in acc_dtypes)
+            self.cap = cap
+            self.batch_cap = batch_cap
+            self.max_probes = max_probes
+            self.emit_cap = emit_cap
+            self.backend = backend
+            (self._step, self._read_multi, self._clear) = _build_slot_jax(
+                self.acc_kinds, self.acc_dtypes, cap, region_size
+            )
+            self._n_flt_lanes = sum(
+                1 for d in self.acc_dtypes if np.issubdtype(d, np.floating))
+            self._n_int_lanes = len(self.acc_dtypes) - self._n_flt_lanes
+            self.state = self._init_jax_state()
+        else:
+            super().__init__(acc_kinds, acc_dtypes, cap=cap, batch_cap=batch_cap,
+                             max_probes=max_probes, emit_cap=emit_cap, backend=backend)
+
+    def _init_jax_state(self):
+        import jax.numpy as jnp
+
+        self.directory = BinSlotDirectory(self.cap, self.region_size)
+        # host spill store (bin, key) -> [acc parts]; fed when regions run out
+        self.spill: dict[tuple[int, int], list] = {}
+        return tuple(
+            jnp.full(self.cap, _identity(k, d), dtype=d)
+            for k, d in zip(self.acc_kinds, self.acc_dtypes)
+        )
+
+    # ------------------------------------------------------------- update
+
+    def _update_chunk(self, key_u64, bins, vals) -> None:
+        m = len(key_u64)
+        ku = key_u64.astype(np.uint64)
+        ks = ku.view(np.int64)
+        b64 = np.asarray(bins).astype(np.int64)
+        codes = splitmix64(ku ^ (b64.astype(np.uint64) * _BIN_MIX))
+        uniq, first, inv = np.unique(codes, return_index=True, return_inverse=True)
+        slots_u = self.directory.lookup_or_assign(uniq, ks[first], b64[first])
+        row_slots = slots_u[inv]
+        vals = [np.asarray(v) for v in vals]
+        spill_rows = row_slots < 0
+        if spill_rows.any():
+            sel = np.flatnonzero(spill_rows)
+            self._spill_update(ks[sel], b64[sel], [v[sel] for v in vals])
+            keep = np.flatnonzero(~spill_rows)
+            row_slots = row_slots[keep]
+            vals = [v[keep] for v in vals]
+            m = len(keep)
+        B = self.batch_cap
+        slots = np.full(B, self.cap, dtype=np.int64)  # pad -> dropped
+        slots[:m] = row_slots
+        vs = []
+        for v, k, dt in zip(vals, self.acc_kinds, self.acc_dtypes):
+            arr = np.full(B, _identity(k, dt), dtype=dt)
+            arr[:m] = v
+            vs.append(arr)
+        self.state = self._step(self.state, slots, tuple(vs))
+
+    def _spill_update(self, keys_i64, bins_i64, vals) -> None:
+        order = np.lexsort((keys_i64, bins_i64))
+        k_s, b_s = keys_i64[order], bins_i64[order]
+        vs = [np.asarray(v)[order] for v in vals]
+        newseg = np.ones(len(k_s), dtype=bool)
+        newseg[1:] = (k_s[1:] != k_s[:-1]) | (b_s[1:] != b_s[:-1])
+        starts = np.flatnonzero(newseg)
+        ends = np.append(starts[1:], len(k_s))
+        store = self.spill
+        for s, e in zip(starts, ends):
+            kk = (int(b_s[s]), int(k_s[s]))
+            cur = store.get(kk)
+            parts = []
+            for i, kind in enumerate(self.acc_kinds):
+                seg = vs[i][s:e]
+                red = (seg.sum() if kind in ("sum", "count")
+                       else (seg.min() if kind == "min" else seg.max()))
+                if cur is not None:
+                    red = (cur[i] + red if kind in ("sum", "count")
+                           else (min(cur[i], red) if kind == "min" else max(cur[i], red)))
+                parts.append(self.acc_dtypes[i].type(red))
+            store[kk] = parts
+
+    def _take_spill(self, emit_lo: int, emit_hi: int, free_below: int):
+        hit = [kk for kk in self.spill if emit_lo <= kk[0] < emit_hi]
+        if not hit:
+            return (np.empty(0, dtype=np.uint64), np.empty(0, dtype=np.int32),
+                    [np.empty(0, dtype=d) for d in self.acc_dtypes])
+        ks = np.array([k for (_b, k) in hit], dtype=np.int64).view(np.uint64)
+        bs = np.array([b for (b, _k) in hit], dtype=np.int32)
+        accs = [np.array([self.spill[kk][i] for kk in hit], dtype=d)
+                for i, d in enumerate(self.acc_dtypes)]
+        for kk in hit:
+            if kk[0] < free_below:
+                del self.spill[kk]
+        return ks, bs, accs
+
+    # ------------------------------------------------------------- extract
+
+    def _collect_regions(self, emit_lo: int, emit_hi: int):
+        """[(bin, base, fill, keys_copy)] for every region of bins in range."""
+        d = self.directory
+        out = []
+        for b in d.live_bins():
+            if not (emit_lo <= b < emit_hi):
+                continue
+            for r in d.bin_regions.get(b, ()):
+                base = r * self.region_size
+                fill = int(d.region_fill[r])
+                out.append((b, base, fill, d.slot_keys[base : base + fill].copy()))
+        return out
+
+    def _read_regions(self, regs, do_clear: bool):
+        """Batch region reads: <=16 regions per device call, k bucketed to a
+        power of two (bases padded by duplication) so each close costs one
+        fetch, not one per region."""
+        groups = []
+        i = 0
+        while i < len(regs):
+            chunk = regs[i : i + 16]
+            i += 16
+            k = 1
+            while k < len(chunk):
+                k *= 2
+            bases = np.array(
+                [c[1] for c in chunk] + [chunk[0][1]] * (k - len(chunk)),
+                dtype=np.int64,
+            )
+            fn = self._read_multi(k, do_clear)
+            if do_clear:
+                self.state, ibuf, fbuf = fn(self.state, bases)
+            else:
+                ibuf, fbuf = fn(self.state, bases)
+            ibuf = ibuf if self._n_int_lanes else None
+            fbuf = fbuf if self._n_flt_lanes else None
+            for buf in (ibuf, fbuf):
+                if buf is None:
+                    continue
+                try:
+                    buf.copy_to_host_async()
+                except AttributeError:
+                    pass
+            groups.append(([(b, keys, fill) for (b, _base, fill, keys) in chunk],
+                           ibuf, fbuf))
+        return groups
+
+    def extract_start(self, emit_lo: int, emit_hi: int, free_below: int) -> SlotExtractHandle:
+        d = self.directory
+        regs_destr = self._collect_regions(emit_lo, min(emit_hi, free_below))
+        regs_keep = self._collect_regions(max(emit_lo, free_below), emit_hi)
+        groups = self._read_regions(regs_destr, do_clear=True)
+        groups += self._read_regions(regs_keep, do_clear=False)
+        for b in [b for b in d.live_bins() if b < free_below]:
+            if not (emit_lo <= b < emit_hi):
+                # non-emitted expired bins: clear without reading
+                for r in d.bin_regions.get(b, ()):
+                    self.state = self._clear(self.state, np.int64(r * self.region_size))
+            d.close_bin(b)
+        spill = self._take_spill(emit_lo, emit_hi, free_below)
+        for kk in [kk for kk in self.spill if kk[0] < free_below]:
+            del self.spill[kk]
+        if free_below > d.boundary:
+            d.boundary = free_below
+        return SlotExtractHandle(self, groups, spill)
+
+    def extract(self, emit_lo: int, emit_hi: int, free_below: int):
+        if self.backend == "numpy":
+            return self._extract_numpy(emit_lo, emit_hi, free_below)
+        return self.extract_start(emit_lo, emit_hi, free_below).result()
+
+    def scan_range(self, emit_lo: int, emit_hi: int):
+        if self.backend == "numpy":
+            return super().scan_range(emit_lo, emit_hi)
+        groups = self._read_regions(self._collect_regions(emit_lo, emit_hi),
+                                    do_clear=False)
+        spill = self._take_spill(emit_lo, emit_hi, free_below=_DEAD_BIN)
+        return SlotExtractHandle(self, groups, spill).result()
+
+    def free_bins_below(self, below: int) -> None:
+        if self.backend == "numpy":
+            return super().free_bins_below(below)
+        d = self.directory
+        for b in d.live_bins():
+            if b < below:
+                for r in d.bin_regions.get(b, ()):
+                    self.state = self._clear(self.state, np.int64(r * self.region_size))
+                d.close_bin(b)
+        for kk in [kk for kk in self.spill if kk[0] < below]:
+            del self.spill[kk]
+        if below > d.boundary:
+            d.boundary = below
+
+    # ------------------------------------------------------------- state sync
+
+    def snapshot(self):
+        if self.backend == "numpy":
+            return super().snapshot()
+        d = self.directory
+        live = d.live_bins()
+        spill_bins = [b for (b, _k) in self.spill]
+        if not live and not spill_bins:
+            return (np.empty(0, dtype=np.uint64), np.empty(0, dtype=np.int32),
+                    [np.empty(0, dtype=dt) for dt in self.acc_dtypes])
+        lo = min(live + spill_bins)
+        hi = max(live + spill_bins) + 1
+        return self.scan_range(lo, hi)

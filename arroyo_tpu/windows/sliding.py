@@ -71,17 +71,17 @@ class SlidingAggregate(Operator):
 
     def _aggregator(self):
         if self._agg is None:
-            from ..ops.aggregate import DeviceHashAggregator
+            from ..ops.slot_agg import SlotAggregator
 
             dev = config().section("device")
-            self._agg = DeviceHashAggregator(
+            self._agg = SlotAggregator(
                 self.acc_kinds,
                 self.acc_dtypes,
                 cap=dev.get("table-capacity", 65536),
                 batch_cap=dev.get("batch-capacity", 8192),
-                max_probes=dev.get("max-probes", 64),
                 emit_cap=dev.get("emit-capacity", 8192),
                 backend=self.backend,
+                region_size=dev.get("region-size", 2048),
             )
         return self._agg
 

@@ -10,13 +10,17 @@ the output timestamp; partials checkpoint into an ExpiringTimeKey table
 TPU-native redesign: partials live in HBM inside a DeviceHashAggregator
 keyed by (bin, key-hash); each micro-batch is one fused XLA step (sort ->
 segment-reduce -> probing merge); window close is a device-side compaction
-(extract) triggered by the watermark. Group-by column VALUES (not hashes) are
-kept in a host-side dictionary (hash -> row of key values) refreshed per
-batch — only the fixed-width hash travels to the device.
+(extract) whose packed result is fetched ASYNCHRONOUSLY — emission and the
+forwarded watermark are pipelined behind subsequent update steps so the host
+never blocks on a device round trip in the hot loop. Numeric group-by key
+VALUES ride along as extra max-accumulator lanes in HBM (all rows of a key
+agree, so max is the identity function); only string-typed keys fall back to
+a host-side hash -> values dictionary.
 """
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -24,13 +28,20 @@ import numpy as np
 from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch
 from ..config import config
 from ..engine.engine import register_operator
-from ..expr import Expr, eval_expr
+from ..expr import Col, Expr, eval_expr
 from ..graph import OpName
 from ..operators.base import Operator, TableSpec
-from ..types import Watermark
+from ..types import Signal, Watermark
 
 WINDOW_START = "window_start"
 WINDOW_END = "window_end"
+
+# in-flight window-close extraction policy: a close is fetched once it has
+# aged _DRAIN_AGE batches (the platform's is_ready() is unreliable over the
+# remote-device tunnel, so age is the readiness proxy) or when the queue
+# exceeds _PIPELINE_DEPTH
+_PIPELINE_DEPTH = 8
+_DRAIN_AGE = 3
 
 
 def acc_plan(aggregates: list[tuple[str, str, Optional[Expr]]], schema_dtype_of) -> tuple:
@@ -60,7 +71,8 @@ def acc_plan(aggregates: list[tuple[str, str, Optional[Expr]]], schema_dtype_of)
 class KeyDictionary:
     """hash -> key-column values, for reconstructing group-by columns at
     emission (device state stores only the 64-bit hash). Entries are evicted
-    once every bin that saw the key has closed, bounding host memory."""
+    once every bin that saw the key has closed, bounding host memory. Used
+    only for non-numeric key columns; numeric keys travel through HBM."""
 
     def __init__(self, key_fields: list[str]):
         self.key_fields = key_fields
@@ -73,19 +85,19 @@ class KeyDictionary:
         u, first = np.unique(hashes, return_index=True)
         u_list = u.tolist()
         # conservative liveness: every key seen in this batch is treated as
-        # live through the batch's max bin. dict.fromkeys + update run at C
-        # speed; per-key exact maxima would cost a Python loop per batch and
-        # only evict (at most) one batch's bin-spread earlier.
+        # live through the batch's max bin; bins grow monotonically across
+        # batches so a plain overwrite never lowers a live key's horizon
+        # by more than one batch's bin spread.
         mx = int(bins.max()) if len(bins) else 0
-        lb = self.last_bin
-        lb.update({h: mx for h in u_list if lb.get(h, -1) < mx})
-        new = [h for h in u_list if h not in self.values]
+        self.last_bin.update(dict.fromkeys(u_list, mx))
+        vals = self.values
+        new = [h for h in u_list if h not in vals]
         if new:
             cols = [batch[f] for f in self.key_fields]
             idx_of = dict(zip(u_list, first.tolist()))
             for h in new:
                 i = idx_of[h]
-                self.values[h] = tuple(c[i] for c in cols)
+                vals[h] = tuple(c[i] for c in cols)
 
     def evict_closed(self, rel_before: int) -> None:
         dead = [h for h, b in self.last_bin.items() if b < rel_before]
@@ -121,15 +133,22 @@ class TumblingAggregate(Operator):
         self.final_projection = cfg.get("final_projection")
         dtype_of = cfg.get("input_dtype_of") or (lambda e: np.dtype(np.float64))
         self.acc_kinds, self.acc_dtypes, self.acc_inputs = acc_plan(self.aggregates, dtype_of)
+        self.n_user_accs = len(self.acc_kinds)
         self.backend = cfg.get("backend") or (
             "jax" if config().get("device.enabled") else "numpy"
         )
         self._agg = None
-        self.key_dict = KeyDictionary(self.key_fields)
+        # key transport split, decided from the first batch's column dtypes
+        self.lane_key_fields: Optional[list[str]] = None  # numeric: HBM lanes
+        self.dict_key_fields: list[str] = []  # strings: host dictionary
+        self.key_dict = KeyDictionary([])
         self.base_bin: Optional[int] = None  # micros bin offset for int32 device bins
         self.open_bins: set[int] = set()  # relative bins resident on device
         self.emitted_before_rel: Optional[int] = None  # late-data boundary
         self.late_rows = 0  # dropped as later than an emitted window
+        # in-flight closes: (ExtractHandle|None, rel_before|None, Watermark|None)
+        self._pending: deque = deque()
+        self._batch_seq = 0
 
     # ------------------------------------------------------------------
 
@@ -137,19 +156,37 @@ class TumblingAggregate(Operator):
         # retention = width: a bin's partials live until its window closes
         return [TableSpec("t", "expiring_time_key", retention_micros=self.width)]
 
+    def _setup_key_transport(self, batch: Batch) -> None:
+        """Split group-by columns by dtype: numeric values are carried in HBM
+        as extra max-lanes (every row of a key holds the same value); the
+        rest go through the host KeyDictionary."""
+        lane, dicty = [], []
+        for f in self.key_fields:
+            col = np.asarray(batch[f])
+            if np.issubdtype(col.dtype, np.integer) or np.issubdtype(col.dtype, np.floating):
+                lane.append((f, col.dtype))
+            else:
+                dicty.append(f)
+        self.lane_key_fields = [f for f, _ in lane]
+        self.dict_key_fields = dicty
+        self.key_dict = KeyDictionary(dicty)
+        self.acc_kinds = self.acc_kinds + tuple("max" for _ in lane)
+        self.acc_dtypes = self.acc_dtypes + tuple(np.dtype(d) for _, d in lane)
+        self.acc_inputs = self.acc_inputs + tuple(Col(f) for f, _ in lane)
+
     def _aggregator(self):
         if self._agg is None:
-            from ..ops.aggregate import DeviceHashAggregator
+            from ..ops.slot_agg import SlotAggregator
 
             dev = config().section("device")
-            self._agg = DeviceHashAggregator(
+            self._agg = SlotAggregator(
                 self.acc_kinds,
                 self.acc_dtypes,
                 cap=dev.get("table-capacity", 65536),
                 batch_cap=dev.get("batch-capacity", 8192),
-                max_probes=dev.get("max-probes", 64),
                 emit_cap=dev.get("emit-capacity", 8192),
                 backend=self.backend,
+                region_size=dev.get("region-size", 2048),
             )
         return self._agg
 
@@ -162,20 +199,33 @@ class TumblingAggregate(Operator):
             tbl.replace_all([])
 
     def _restore_from_batch(self, b: Batch) -> None:
+        # checkpoints carry every key field as a named column, so the
+        # transport split can be re-derived from the checkpoint batch itself
+        if self.lane_key_fields is None:
+            self._setup_key_transport(b)
         hashes = b.keys.astype(np.uint64)
         starts = b.timestamps
         bins_abs = starts // self.width
         self.base_bin = int(bins_abs.min())
         rel = (bins_abs - self.base_bin).astype(np.int32)
-        accs = [b[f"__acc_{i}"].astype(d) for i, d in enumerate(self.acc_dtypes)]
+        accs = [b[f"__acc_{i}"].astype(d)
+                for i, d in enumerate(self.acc_dtypes[: self.n_user_accs])]
+        accs += [np.asarray(b[f]).astype(d)
+                 for f, d in zip(self.lane_key_fields,
+                                 self.acc_dtypes[self.n_user_accs:])]
         self._aggregator().restore(hashes, rel, accs)
         self.open_bins = set(np.unique(rel).tolist())
-        if self.key_fields:
+        if self.dict_key_fields:
             self.key_dict.observe(hashes, rel, b)
 
     # ------------------------------------------------------------------
 
     def process_batch(self, batch, ctx, collector, input_index=0):
+        self._batch_seq += 1
+        if self._pending:
+            self._drain_pending(collector)
+        if self.lane_key_fields is None:
+            self._setup_key_transport(batch)
         ts = batch.timestamps
         bins_abs = ts // self.width
         if self.base_bin is None:
@@ -196,7 +246,8 @@ class TumblingAggregate(Operator):
             hashes = batch.keys.astype(np.uint64)
         else:
             hashes = np.zeros(n, dtype=np.uint64)
-        self.key_dict.observe(hashes, rel, batch)
+        if self.dict_key_fields:
+            self.key_dict.observe(hashes, rel, batch)
         vals = []
         for inp, dt in zip(self.acc_inputs, self.acc_dtypes):
             if inp is None:
@@ -206,24 +257,63 @@ class TumblingAggregate(Operator):
         self._aggregator().update(hashes, rel, vals)
         self.open_bins.update(np.unique(rel).tolist())
 
+    # ------------------------------------------------------------- emission
+
+    def _drain_pending(self, collector, force: bool = False) -> None:
+        """Emit completed in-flight closes in order; each close's watermark
+        broadcasts only after its rows, preserving downstream lateness
+        semantics."""
+        while self._pending:
+            handle, rel_before, wm, seq = self._pending[0]
+            if handle is not None and not force:
+                aged = self._batch_seq - seq >= _DRAIN_AGE
+                ready = False
+                if not aged:
+                    try:
+                        ready = handle.is_ready()
+                    except AttributeError:
+                        ready = True
+                if not (aged or ready):
+                    return
+            self._pending.popleft()
+            if handle is not None:
+                keys, bins, accs = handle.result()
+                if len(keys):
+                    self._emit_entries(keys, bins, accs, collector)
+                if self.dict_key_fields:
+                    self.key_dict.evict_closed(rel_before)
+            if wm is not None:
+                collector.broadcast(Signal.watermark_of(wm))
+
     def handle_watermark(self, watermark, ctx, collector):
         if watermark.is_idle:
+            self._drain_pending(collector, force=True)
             return watermark
         closed_before_abs = watermark.value // self.width
-        self._emit_closed(closed_before_abs, collector)
         # Future emissions are stamped with a window start >= bin_start(w);
         # forward that instead of w so downstream operators (e.g. windowed
         # joins) never see our output as late. The reference forwards w
         # unchanged and relies on sparse watermarks; with dense per-batch
         # watermarks the adjusted value is required for correctness.
-        return Watermark.event_time(closed_before_abs * self.width)
+        out_wm = Watermark.event_time(closed_before_abs * self.width)
+        scheduled = self._schedule_close(closed_before_abs, out_wm, collector)
+        if scheduled or self._pending:
+            return None  # watermark rides the pending queue, in order
+        return out_wm
 
     def on_close(self, ctx, collector):
-        self._emit_closed(None, collector)
+        self._schedule_close(None, None, collector)
+        self._drain_pending(collector, force=True)
 
-    def _emit_closed(self, closed_before_abs: Optional[int], collector) -> None:
+    def _schedule_close(self, closed_before_abs: Optional[int],
+                        out_wm: Optional[Watermark], collector) -> bool:
+        """Dispatch the device extraction for every bin closed by the
+        watermark; returns True if a close (or watermark hold) was queued."""
         if self.base_bin is None or not self.open_bins:
-            return
+            if out_wm is not None and self._pending:
+                self._pending.append((None, None, out_wm, self._batch_seq))
+                return True
+            return False
         if closed_before_abs is None:
             rel_before = max(self.open_bins) + 1
         else:
@@ -232,24 +322,37 @@ class TumblingAggregate(Operator):
             self.emitted_before_rel = rel_before
         closing = sorted(b for b in self.open_bins if b < rel_before)
         if not closing:
-            return
-        keys, bins, accs = self._aggregator().extract(
-            min(closing), rel_before, rel_before
-        )
+            if out_wm is not None and self._pending:
+                self._pending.append((None, None, out_wm, self._batch_seq))
+                return True
+            return False
+        agg = self._aggregator()
         self.open_bins -= set(closing)
-        if len(keys):
-            self._emit_entries(keys, bins, accs, collector)
-        self.key_dict.evict_closed(rel_before)
+        if self.backend == "numpy":
+            keys, bins, accs = agg.extract(min(closing), rel_before, rel_before)
+            if len(keys):
+                self._emit_entries(keys, bins, accs, collector)
+            if self.dict_key_fields:
+                self.key_dict.evict_closed(rel_before)
+            return False  # synchronous: caller forwards the watermark itself
+        if len(self._pending) >= _PIPELINE_DEPTH:
+            self._drain_pending(collector, force=True)
+        handle = agg.extract_start(min(closing), rel_before, rel_before)
+        self._pending.append((handle, rel_before, out_wm, self._batch_seq))
+        return True
 
     def _emit_entries(self, keys, bins, accs, collector) -> None:
         from ..ops.aggregate import finalize_aggs
 
         starts = (bins.astype(np.int64) + self.base_bin) * self.width
         cols: dict[str, np.ndarray] = {}
-        cols.update(self.key_dict.lookup_columns(keys))
+        if self.dict_key_fields:
+            cols.update(self.key_dict.lookup_columns(keys))
+        for f, lane in zip(self.lane_key_fields, accs[self.n_user_accs:]):
+            cols[f] = lane
         cols[WINDOW_START] = starts
         cols[WINDOW_END] = starts + self.width
-        finals = finalize_aggs([a[1] for a in self.aggregates], accs)
+        finals = finalize_aggs([a[1] for a in self.aggregates], accs[: self.n_user_accs])
         for (name, _k, _e), arr in zip(self.aggregates, finals):
             cols[name] = arr
         # reference stamps the window start as the output event time
@@ -266,6 +369,9 @@ class TumblingAggregate(Operator):
     # ------------------------------------------------------------------
 
     def handle_checkpoint(self, barrier, ctx, collector):
+        # flush in-flight emissions first: their rows/watermarks must precede
+        # the barrier, and the snapshot must not race follow-up extractions
+        self._drain_pending(collector, force=True)
         keys, bins, accs = self._aggregator().snapshot()
         tbl = ctx.table_manager.expiring_time_key("t", self.width)
         if len(keys) == 0:
@@ -276,8 +382,11 @@ class TumblingAggregate(Operator):
             TIMESTAMP_FIELD: starts,
             KEY_FIELD: keys,
         }
-        cols.update(self.key_dict.lookup_columns(keys))
-        for i, a in enumerate(accs):
+        if self.dict_key_fields:
+            cols.update(self.key_dict.lookup_columns(keys))
+        for f, lane in zip(self.lane_key_fields or [], accs[self.n_user_accs:]):
+            cols[f] = lane
+        for i, a in enumerate(accs[: self.n_user_accs]):
             cols[f"__acc_{i}"] = a
         tbl.replace_all([Batch(cols)])
 

@@ -57,9 +57,18 @@ def build_graph(rows_sink, backend: str, event_count: int):
     return g
 
 
-def run_once(backend: str, event_count: int) -> tuple[float, int, list]:
+def run_once(backend: str, event_count: int, batch_size: int = None) -> tuple[float, int, list]:
+    from arroyo_tpu import config as cfg
     from arroyo_tpu.engine import run_graph
 
+    if batch_size is not None:
+        # each backend runs at its own best batch size (the device path
+        # amortizes dispatch/fetch round trips over bigger batches; the
+        # numpy baseline's dict store prefers smaller ones)
+        cfg.update({
+            "pipeline.source-batch-size": batch_size,
+            "device.batch-capacity": batch_size,
+        })
     rows: list = []
     g = build_graph(rows, backend, event_count)
     t0 = time.perf_counter()
@@ -79,6 +88,7 @@ def main() -> None:
     arroyo_tpu._load_operators()
     cfg.update({
         "pipeline.source-batch-size": 8192,
+        "pipeline.chaining.enabled": True,
         "device.batch-capacity": 8192,
         "device.table-capacity": 65536,
         "device.emit-capacity": 8192,
@@ -89,10 +99,10 @@ def main() -> None:
     base_events = int(os.environ.get("ARROYO_BENCH_BASELINE_EVENTS", 500_000))
 
     # warm-up: compile the device step on small input
-    w_wall, _, _ = run_once("jax", 50_000)
+    w_wall, _, _ = run_once("jax", 50_000, batch_size=32768)
     print(f"# warmup (compile): {w_wall:.1f}s", file=sys.stderr)
 
-    wall, n, rows = run_once("jax", events)
+    wall, n, rows = run_once("jax", events, batch_size=32768)
     eps = n / wall
     expected_bids = int(n * 46 / 50)
     got_bids = sum(int(b["bids"].sum()) for b in rows)
@@ -100,7 +110,7 @@ def main() -> None:
     print(f"# tpu-path: {n} events in {wall:.2f}s = {eps:,.0f} events/s; "
           f"{sum(b.num_rows for b in rows)} windows, parity OK", file=sys.stderr)
 
-    b_wall, b_n, b_rows = run_once("numpy", base_events)
+    b_wall, b_n, b_rows = run_once("numpy", base_events, batch_size=8192)
     b_eps = b_n / b_wall
     assert sum(int(b["bids"].sum()) for b in b_rows) == int(b_n * 46 / 50)
     print(f"# numpy-baseline: {b_n} events in {b_wall:.2f}s = {b_eps:,.0f} events/s",
