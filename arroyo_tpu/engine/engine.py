@@ -75,6 +75,16 @@ class Engine:
             from ..optimizer import chain_graph
 
             graph = chain_graph(graph)
+        if assignment is not None:
+            # assignments computed against a differently-chained graph would
+            # silently place fused subtasks on worker 0; reject instead
+            unknown = {nid for nid, _ in assignment} - set(graph.nodes)
+            if unknown:
+                raise ValueError(
+                    f"assignment references node ids not in the (post-chaining) "
+                    f"graph: {sorted(unknown)}; compute assignments against the "
+                    f"same pipeline.chaining.enabled setting"
+                )
         self.graph = graph
         self.job_id = job_id
         self.storage_url = storage_url or config().get("checkpoint.storage-url")
@@ -111,6 +121,20 @@ class Engine:
 
     def build(self) -> None:
         g = self.graph
+        if self.restore_epoch is not None:
+            from ..state.tables import read_job_checkpoint_metadata
+
+            meta = read_job_checkpoint_metadata(
+                self.storage_url, self.job_id, self.restore_epoch
+            )
+            stale = set((meta or {}).get("operators", ())) - set(g.nodes)
+            if stale:
+                raise RuntimeError(
+                    f"checkpoint epoch {self.restore_epoch} holds state for "
+                    f"operators {sorted(stale)} that do not exist in this graph "
+                    f"— restoring across a pipeline.chaining.enabled change (or "
+                    f"a graph edit) would silently drop their state"
+                )
         queue_size = config().get("worker.queue-size")
         # flat-input layout per node: in-edge order, then upstream subtask
         in_layout: dict[str, list[tuple[int, int]]] = {}  # node -> [(edge_i, parallelism)]

@@ -362,7 +362,10 @@ def _build_jax(acc_kinds: tuple[str, ...], acc_dtypes: tuple, cap: int, batch_ca
     n_acc = len(acc_kinds)
 
     def _to_i64(a, dtype):
-        """Lossless int64 lane for transport: floats are bitcast, ints cast."""
+        """Lossless int64 lane for transport. Floats would need a 64-bit
+        bitcast, which is unsupported under TPU x64 emulation — the host
+        wrapper routes float accumulator sets to the unpacked extract/scan
+        paths instead, so this only ever sees integer lanes there."""
         if np.issubdtype(np.dtype(dtype), np.floating):
             return jax.lax.bitcast_convert_type(a.astype(jnp.float64), jnp.int64)
         return a.astype(jnp.int64)
@@ -487,6 +490,20 @@ class ExtractHandle:
         )
 
 
+class ReadyHandle:
+    """ExtractHandle-compatible wrapper over an already-materialized result
+    (synchronous fallback paths)."""
+
+    def __init__(self, result):
+        self._result = result
+
+    def is_ready(self) -> bool:
+        return True
+
+    def result(self):
+        return self._result
+
+
 class DeviceHashAggregator:
     """Streaming (bin, key) -> accumulators store.
 
@@ -512,6 +529,12 @@ class DeviceHashAggregator:
         self.max_probes = max_probes
         self.emit_cap = emit_cap
         self.backend = backend
+        # the single-buffer packed transport bitcasts float64 -> int64, which
+        # TPU x64 emulation cannot compile; float accumulator sets use the
+        # unpacked (multi-fetch) extract/scan paths instead
+        self._packed_ok = not any(
+            np.issubdtype(d, np.floating) for d in self.acc_dtypes
+        )
         if backend == "jax":
             (self._step, self._extract, self._scan, self._free,
              self._extract_packed, self._scan_packed) = _build_jax(
@@ -627,10 +650,45 @@ class DeviceHashAggregator:
             return self._extract_numpy(emit_lo, emit_hi, free_below)
         return self.extract_start(emit_lo, emit_hi, free_below).result()
 
+    def _extract_unpacked(self, emit_lo: int, emit_hi: int, free_below: int):
+        """Synchronous extract via the typed (non-packed) device path — used
+        for float accumulator sets, where the packed int64 transport's
+        float64 bitcast does not compile under TPU x64 emulation."""
+        keys_out, bins_out = [], []
+        accs_out: list[list[np.ndarray]] = [[] for _ in self.acc_dtypes]
+        while True:
+            self.state, (k, b, valid, accs, total) = self._extract(
+                self.state, np.int32(emit_lo), np.int32(emit_hi), np.int32(free_below)
+            )
+            valid = np.asarray(valid)
+            total = int(total)
+            if valid.any():
+                keys_out.append(np.asarray(k)[valid].view(np.uint64))
+                bins_out.append(np.asarray(b)[valid])
+                for i, a in enumerate(accs):
+                    accs_out[i].append(np.asarray(a)[valid])
+            if total <= self.emit_cap or not valid.any() or free_below <= emit_lo:
+                break
+        self._check_overflow()
+        if not keys_out:
+            return (
+                np.empty(0, dtype=np.uint64),
+                np.empty(0, dtype=np.int32),
+                [np.empty(0, dtype=d) for d in self.acc_dtypes],
+            )
+        return combine_by_key_bin(
+            self.acc_kinds,
+            np.concatenate(keys_out),
+            np.concatenate(bins_out),
+            [np.concatenate(a).astype(d) for a, d in zip(accs_out, self.acc_dtypes)],
+        )
+
     def extract_start(self, emit_lo: int, emit_hi: int, free_below: int) -> ExtractHandle:
         """Dispatch a window-close extraction without blocking: the device
         compacts + frees immediately, the packed result streams to host in
         the background. The caller emits later via handle.result()."""
+        if not self._packed_ok:
+            return ReadyHandle(self._extract_unpacked(emit_lo, emit_hi, free_below))
         self.state, packed = self._extract_packed(
             self.state, np.int32(emit_lo), np.int32(emit_hi), np.int32(free_below)
         )
@@ -657,12 +715,15 @@ class DeviceHashAggregator:
                 np.array(bs, dtype=np.int32),
                 [np.array(a, dtype=d) for a, d in zip(accs, self.acc_dtypes)],
             )
-        # fast path: one packed transfer covers the whole range
-        packed = np.asarray(self._scan_packed(
-            self.state, np.int32(emit_lo), np.int32(emit_hi)))
-        k, b, accs, total = self._unpack(packed)
-        if total <= self.emit_cap:
-            return combine_by_key_bin(self.acc_kinds, k, b, accs)
+        if self._packed_ok:
+            # fast path: one packed transfer covers the whole range
+            packed = np.asarray(self._scan_packed(
+                self.state, np.int32(emit_lo), np.int32(emit_hi)))
+            k, b, accs, total = self._unpack(packed)
+            if total <= self.emit_cap:
+                return combine_by_key_bin(self.acc_kinds, k, b, accs)
+        else:
+            self._check_overflow()
         keys_out, bins_out = [], []
         accs_out: list[list[np.ndarray]] = [[] for _ in self.acc_dtypes]
         for chunk in range(0, self.cap, self.emit_cap):

@@ -478,6 +478,14 @@ def write_job_checkpoint_metadata(
     return path
 
 
+def read_job_checkpoint_metadata(storage_url: str, job_id: str, epoch: int) -> Optional[dict]:
+    path = os.path.join(checkpoint_dir(storage_url, job_id, epoch), "metadata.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
 def latest_complete_checkpoint(storage_url: str, job_id: str) -> Optional[int]:
     base = os.path.join(storage_url, job_id, "checkpoints")
     if not os.path.isdir(base):

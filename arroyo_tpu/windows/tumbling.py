@@ -84,12 +84,17 @@ class KeyDictionary:
             return
         u, first = np.unique(hashes, return_index=True)
         u_list = u.tolist()
-        # conservative liveness: every key seen in this batch is treated as
-        # live through the batch's max bin; bins grow monotonically across
-        # batches so a plain overwrite never lowers a live key's horizon
-        # by more than one batch's bin spread.
+        # conservative liveness: every key seen in this batch is live through
+        # the batch's max bin. The update must be monotone — out-of-order
+        # batches (normal after a keyed shuffle at parallelism>1) may carry a
+        # lower max bin, and lowering a key's horizon would let evict_closed
+        # delete values still resident on device.
         mx = int(bins.max()) if len(bins) else 0
-        self.last_bin.update(dict.fromkeys(u_list, mx))
+        lb = self.last_bin
+        for h in u_list:
+            v = lb.get(h)
+            if v is None or v < mx:  # rel bins can be negative: no sentinel
+                lb[h] = mx
         vals = self.values
         new = [h for h in u_list if h not in vals]
         if new:
@@ -289,6 +294,11 @@ class TumblingAggregate(Operator):
         if watermark.is_idle:
             self._drain_pending(collector, force=True)
             return watermark
+        if self._pending:
+            # during a data gap watermarks keep arriving with no batches to
+            # trigger draining; drain ripe closes here so the pending queue
+            # stays bounded and rows are not held indefinitely
+            self._drain_pending(collector)
         closed_before_abs = watermark.value // self.width
         # Future emissions are stamped with a window start >= bin_start(w);
         # forward that instead of w so downstream operators (e.g. windowed
@@ -305,15 +315,24 @@ class TumblingAggregate(Operator):
         self._schedule_close(None, None, collector)
         self._drain_pending(collector, force=True)
 
+    def _hold_watermark(self, out_wm: Optional[Watermark], collector) -> bool:
+        """No bins are closing: if earlier closes are still in flight, queue
+        the watermark behind them (bounded by the pipeline depth); returns
+        True when held, False when the caller should forward it."""
+        if out_wm is None or not self._pending:
+            return False
+        if len(self._pending) >= _PIPELINE_DEPTH:
+            self._drain_pending(collector, force=True)
+            return False
+        self._pending.append((None, None, out_wm, self._batch_seq))
+        return True
+
     def _schedule_close(self, closed_before_abs: Optional[int],
                         out_wm: Optional[Watermark], collector) -> bool:
         """Dispatch the device extraction for every bin closed by the
         watermark; returns True if a close (or watermark hold) was queued."""
         if self.base_bin is None or not self.open_bins:
-            if out_wm is not None and self._pending:
-                self._pending.append((None, None, out_wm, self._batch_seq))
-                return True
-            return False
+            return self._hold_watermark(out_wm, collector)
         if closed_before_abs is None:
             rel_before = max(self.open_bins) + 1
         else:
@@ -322,10 +341,7 @@ class TumblingAggregate(Operator):
             self.emitted_before_rel = rel_before
         closing = sorted(b for b in self.open_bins if b < rel_before)
         if not closing:
-            if out_wm is not None and self._pending:
-                self._pending.append((None, None, out_wm, self._batch_seq))
-                return True
-            return False
+            return self._hold_watermark(out_wm, collector)
         agg = self._aggregator()
         self.open_bins -= set(closing)
         if self.backend == "numpy":
@@ -372,8 +388,14 @@ class TumblingAggregate(Operator):
         # flush in-flight emissions first: their rows/watermarks must precede
         # the barrier, and the snapshot must not race follow-up extractions
         self._drain_pending(collector, force=True)
-        keys, bins, accs = self._aggregator().snapshot()
         tbl = ctx.table_manager.expiring_time_key("t", self.width)
+        if self._agg is None:
+            # no data yet: building the aggregator here would freeze acc_kinds
+            # before _setup_key_transport appends the numeric key lanes, so
+            # later updates would silently drop lane values (zip truncation)
+            tbl.replace_all([])
+            return
+        keys, bins, accs = self._agg.snapshot()
         if len(keys) == 0:
             tbl.replace_all([])
             return

@@ -177,3 +177,48 @@ def test_backpressure_bounded_queue():
     inbox.release(idx, item)
     t.join(timeout=5)
     assert blocked_done
+
+
+def test_assignment_unknown_node_rejected():
+    """Assignments computed against a differently-chained graph must be
+    rejected, not silently defaulted to worker 0 (advisor r2 low)."""
+    import pytest
+
+    from arroyo_tpu.batch import Schema, TIMESTAMP_FIELD
+    from arroyo_tpu.engine import Engine
+    from arroyo_tpu.graph import EdgeType, Graph, Node, OpName
+
+    S = Schema.of([("x", "int64"), (TIMESTAMP_FIELD, "int64")])
+    g = Graph()
+    g.add_node(Node("src", OpName.SOURCE, {
+        "connector": "impulse", "message_count": 1,
+        "interval_micros": 1000, "start_time_micros": 0}, 1))
+    g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": []}, 1))
+    g.add_edge("src", "sink", EdgeType.FORWARD, S)
+    with pytest.raises(ValueError, match="assignment references node ids"):
+        Engine(g, assignment={("src+sink", 0): 0}, worker_index=0)
+
+
+def test_restore_graph_mismatch_rejected(tmp_path):
+    """Restoring a checkpoint whose operator ids don't exist in the current
+    graph (e.g. chaining flipped across a restore) must fail loudly instead
+    of silently dropping state (advisor r2 low)."""
+    import pytest
+
+    from arroyo_tpu.batch import Schema, TIMESTAMP_FIELD
+    from arroyo_tpu.engine import Engine
+    from arroyo_tpu.graph import EdgeType, Graph, Node, OpName
+    from arroyo_tpu.state.tables import write_job_checkpoint_metadata
+
+    storage = str(tmp_path / "ck")
+    write_job_checkpoint_metadata(storage, "j1", 1, {"operators": ["wm+key+agg"]})
+    S = Schema.of([("x", "int64"), (TIMESTAMP_FIELD, "int64")])
+    g = Graph()
+    g.add_node(Node("src", OpName.SOURCE, {
+        "connector": "impulse", "message_count": 1,
+        "interval_micros": 1000, "start_time_micros": 0}, 1))
+    g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": []}, 1))
+    g.add_edge("src", "sink", EdgeType.FORWARD, S)
+    eng = Engine(g, job_id="j1", storage_url=storage, restore_epoch=1)
+    with pytest.raises(RuntimeError, match="chaining"):
+        eng.build()
