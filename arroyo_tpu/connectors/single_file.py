@@ -53,12 +53,27 @@ class SingleFileSource(SourceOperator):
         # test-only throttle so mid-stream checkpoints are meaningful
         # (reference smoke tests get this from their rate-limited sources)
         delay_us = config().get("testing.source-read-delay-micros", 0)
+        # deterministic mid-stream gate (reference smoke_tests.rs:300-356
+        # drives the source by hand instead): after reading half the input,
+        # hold — still answering control/checkpoints — until ``gate_epochs``
+        # barriers have been processed. Guarantees checkpoints land
+        # mid-stream regardless of scheduling, so the restore leg of the
+        # smoke harness can never be silently skipped.
+        gate_epochs = config().get("testing.source-gate-epochs", 0)
+        gate_line = len(my_lines) // 2
+        seen_epochs = 0
         while i < len(my_lines):
             if delay_us:
                 import time as _time
 
                 _time.sleep(delay_us / 1e6)
+            holding = gate_epochs and seen_epochs < gate_epochs and i >= gate_line
             msg = sctx.poll_control()
+            if msg is None and holding:
+                import time as _time
+
+                _time.sleep(0.001)
+                continue
             if msg is not None:
                 if msg.kind == "checkpoint":
                     b = de.flush()
@@ -66,10 +81,13 @@ class SingleFileSource(SourceOperator):
                         collector.collect(b)
                     tbl.insert(sub, i)
                     sctx.start_checkpoint(msg.barrier)
+                    seen_epochs += 1
                     if msg.barrier.then_stop:
                         return SourceFinishType.FINAL
                 elif msg.kind == "stop":
                     return SourceFinishType.IMMEDIATE
+                if holding:
+                    continue
             line = my_lines[i]
             i += 1
             if line.strip():

@@ -438,6 +438,40 @@ def _build_jax(acc_kinds: tuple[str, ...], acc_dtypes: tuple, cap: int, batch_ca
 # =========================================================================
 
 
+def _drain_extract_rounds(agg, first, next_round, emit_lo: int, free_below: int):
+    """Shared drain loop for destructive extracts that return at most
+    emit_cap rows per round. ``first`` is the already-fetched first round
+    (keys_u64, bins, accs, total); ``next_round()`` dispatches + decodes one
+    more round. Termination: a round that covered everything
+    (total <= emit_cap), emitted nothing (no progress possible — all
+    leftovers outside the emit range), or a non-destructive call
+    (free_below <= emit_lo: re-reading would duplicate, not drain)."""
+    keys_out, bins_out = [], []
+    accs_out: list[list[np.ndarray]] = [[] for _ in agg.acc_dtypes]
+    k, b, accs, total = first
+    while True:
+        if len(k):
+            keys_out.append(k)
+            bins_out.append(b)
+            for i, a in enumerate(accs):
+                accs_out[i].append(a)
+        if total <= agg.emit_cap or len(k) == 0 or free_below <= emit_lo:
+            break
+        k, b, accs, total = next_round()
+    if not keys_out:
+        return (
+            np.empty(0, dtype=np.uint64),
+            np.empty(0, dtype=np.int32),
+            [np.empty(0, dtype=d) for d in agg.acc_dtypes],
+        )
+    return combine_by_key_bin(
+        agg.acc_kinds,
+        np.concatenate(keys_out),
+        np.concatenate(bins_out),
+        [np.concatenate(a).astype(d) for a, d in zip(accs_out, agg.acc_dtypes)],
+    )
+
+
 class ExtractHandle:
     """In-flight window-close extraction: the device compaction has been
     dispatched and its packed result buffer is copying to host in the
@@ -458,35 +492,17 @@ class ExtractHandle:
 
     def result(self) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
         agg = self._agg
-        keys_out, bins_out = [], []
-        accs_out: list[list[np.ndarray]] = [[] for _ in agg.acc_dtypes]
-        packed = self._packed
-        while True:
-            k, b, accs, total = agg._unpack(np.asarray(packed))
-            if len(k):
-                keys_out.append(k)
-                bins_out.append(b)
-                for i, a in enumerate(accs):
-                    accs_out[i].append(a)
-            # destructive close shrinks each round; a round that emitted
-            # nothing cannot make progress (all leftovers outside emit range)
-            if total <= agg.emit_cap or len(k) == 0 or self._free_below <= self._emit_lo:
-                break
+
+        def next_round():
             agg.state, packed = agg._extract_packed(
                 agg.state, np.int32(self._emit_lo), np.int32(self._emit_hi),
                 np.int32(self._free_below),
             )
-        if not keys_out:
-            return (
-                np.empty(0, dtype=np.uint64),
-                np.empty(0, dtype=np.int32),
-                [np.empty(0, dtype=d) for d in agg.acc_dtypes],
-            )
-        return combine_by_key_bin(
-            agg.acc_kinds,
-            np.concatenate(keys_out).view(np.uint64),
-            np.concatenate(bins_out),
-            [np.concatenate(a) for a in accs_out],
+            return agg._unpack(np.asarray(packed))
+
+        return _drain_extract_rounds(
+            agg, agg._unpack(np.asarray(self._packed)), next_round,
+            self._emit_lo, self._free_below,
         )
 
 
@@ -654,34 +670,22 @@ class DeviceHashAggregator:
         """Synchronous extract via the typed (non-packed) device path — used
         for float accumulator sets, where the packed int64 transport's
         float64 bitcast does not compile under TPU x64 emulation."""
-        keys_out, bins_out = [], []
-        accs_out: list[list[np.ndarray]] = [[] for _ in self.acc_dtypes]
-        while True:
+
+        def round_():
             self.state, (k, b, valid, accs, total) = self._extract(
                 self.state, np.int32(emit_lo), np.int32(emit_hi), np.int32(free_below)
             )
             valid = np.asarray(valid)
-            total = int(total)
-            if valid.any():
-                keys_out.append(np.asarray(k)[valid].view(np.uint64))
-                bins_out.append(np.asarray(b)[valid])
-                for i, a in enumerate(accs):
-                    accs_out[i].append(np.asarray(a)[valid])
-            if total <= self.emit_cap or not valid.any() or free_below <= emit_lo:
-                break
-        self._check_overflow()
-        if not keys_out:
             return (
-                np.empty(0, dtype=np.uint64),
-                np.empty(0, dtype=np.int32),
-                [np.empty(0, dtype=d) for d in self.acc_dtypes],
+                np.asarray(k)[valid].view(np.uint64),
+                np.asarray(b)[valid],
+                [np.asarray(a)[valid] for a in accs],
+                int(total),
             )
-        return combine_by_key_bin(
-            self.acc_kinds,
-            np.concatenate(keys_out),
-            np.concatenate(bins_out),
-            [np.concatenate(a).astype(d) for a, d in zip(accs_out, self.acc_dtypes)],
-        )
+
+        out = _drain_extract_rounds(self, round_(), round_, emit_lo, free_below)
+        self._check_overflow()
+        return out
 
     def extract_start(self, emit_lo: int, emit_hi: int, free_below: int) -> ExtractHandle:
         """Dispatch a window-close extraction without blocking: the device

@@ -473,8 +473,12 @@ def write_job_checkpoint_metadata(
     payload = {"job_id": job_id, "epoch": epoch}
     if extra:
         payload.update(extra)
-    with open(path, "w") as f:
+    # atomic publish: the marker's existence declares the epoch complete, so
+    # a torn write must never be visible under the final name
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(payload, f)
+    os.replace(tmp, path)
     return path
 
 
@@ -482,8 +486,13 @@ def read_job_checkpoint_metadata(storage_url: str, job_id: str, epoch: int) -> O
     path = os.path.join(checkpoint_dir(storage_url, job_id, epoch), "metadata.json")
     if not os.path.exists(path):
         return None
-    with open(path) as f:
-        return json.load(f)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError):
+        # pre-atomic-write torn file: treat as metadata-less (restore
+        # validation is skipped, matching pre-validation behavior)
+        return None
 
 
 def latest_complete_checkpoint(storage_url: str, job_id: str) -> Optional[int]:

@@ -15,7 +15,6 @@ from __future__ import annotations
 import glob
 import json
 import os
-import time
 
 import pytest
 
@@ -134,9 +133,12 @@ def build(sql: str, parallelism: int, job_id: str, restore_epoch=None):
     return eng
 
 
+@pytest.mark.parametrize("chaining", [False, True], ids=["unchained", "chained"])
 @pytest.mark.parametrize("name", QUERIES)
-def test_smoke(name, tmp_path, _storage):
+def test_smoke(name, chaining, tmp_path, _storage):
     from arroyo_tpu import config as cfg
+
+    cfg.update({"pipeline.chaining.enabled": chaining})
 
     # ---- run 1: parallelism 1, to completion --------------------------
     out1 = str(tmp_path / "out1.json")
@@ -145,34 +147,34 @@ def test_smoke(name, tmp_path, _storage):
     assert_outputs(name, out1)
 
     # ---- run 2: parallelism 2, checkpoints 1-3, stop at 3 -------------
+    # the source gate holds every source mid-file until 3 barriers have
+    # passed, so the mid-stream stop is deterministic (never silently
+    # degrades to a completed run; reference smoke_tests.rs:300-356)
     out2 = str(tmp_path / "out2.json")
     sql2 = load_sql(name, out2)
-    cfg.update({"testing.source-read-delay-micros": 4000})
-    stopped_mid_stream = True
+    cfg.update({"testing.source-gate-epochs": 3})
     try:
         eng2 = build(sql2, 2, f"{name}-ckpt")
         eng2.start()
         for epoch in (1, 2):
-            time.sleep(0.05)
-            if not eng2.checkpoint_and_wait(epoch, timeout=60):
-                stopped_mid_stream = False  # pipeline drained before epoch
-                break
+            assert eng2.checkpoint_and_wait(epoch, timeout=60), (
+                f"checkpoint epoch {epoch} did not complete mid-stream"
+            )
             if epoch == 2:
                 # reference runs state compaction after epoch 2
                 eng2.compact(2)
-        if stopped_mid_stream:
-            time.sleep(0.05)
-            stopped_mid_stream = eng2.checkpoint_and_wait(3, timeout=60, then_stop=True)
+        assert eng2.checkpoint_and_wait(3, timeout=60, then_stop=True), (
+            "checkpoint epoch 3 (stopping) did not complete mid-stream"
+        )
         eng2.join(timeout=120)
     finally:
-        cfg.update({"testing.source-read-delay-micros": 0})
+        cfg.update({"testing.source-gate-epochs": 0})
 
     # ---- run 3: restore from epoch 3 at parallelism 3, finish ---------
-    if stopped_mid_stream:
-        # compact the restore epoch + GC older epochs first: restore must
-        # work from compacted generation-1 files alone
-        eng2.compact(3)
-        eng2.cleanup(min_epoch=3)
-        eng3 = build(sql2, 3, f"{name}-ckpt", restore_epoch=3)
-        eng3.run_to_completion(timeout=180)
+    # compact the restore epoch + GC older epochs first: restore must
+    # work from compacted generation-1 files alone
+    eng2.compact(3)
+    eng2.cleanup(min_epoch=3)
+    eng3 = build(sql2, 3, f"{name}-ckpt", restore_epoch=3)
+    eng3.run_to_completion(timeout=180)
     assert_outputs(name, out2)
