@@ -1,0 +1,32 @@
+import time, numpy as np, jax, jax.numpy as jnp
+
+@jax.jit
+def tiny(x): return x + 1
+
+small = jnp.zeros(2048*3, jnp.int32); small.block_until_ready()
+tiny(small).block_until_ready()
+
+# aged fetch: dispatch, async-copy, do 50ms of fake host work, then asarray
+for wait in (0.0, 0.002, 0.01, 0.05):
+    ts = []
+    for _ in range(10):
+        h = tiny(small); h.copy_to_host_async()
+        t_w = time.perf_counter()
+        while time.perf_counter() - t_w < wait:
+            np.random.rand(10000).sum()
+        t0 = time.perf_counter()
+        np.asarray(h)
+        ts.append(time.perf_counter() - t0)
+    print(f"materialize after {wait*1000:4.0f}ms aging: avg {np.mean(ts)*1000:6.2f} ms  max {np.max(ts)*1000:6.2f}")
+
+# k coalesced fetches materialized together after aging
+for k in (1, 4, 16):
+    hs = []
+    for _ in range(k):
+        h = tiny(small); h.copy_to_host_async(); hs.append(h)
+    t_w = time.perf_counter()
+    while time.perf_counter() - t_w < 0.05:
+        np.random.rand(10000).sum()
+    t0 = time.perf_counter()
+    for h in hs: np.asarray(h)
+    print(f"materialize {k:2d} aged handles together: {(time.perf_counter()-t0)*1000:6.2f} ms total")

@@ -1,0 +1,20 @@
+import time, threading, numpy as np, jax, jax.numpy as jnp
+
+@jax.jit
+def tiny(x): return x + 1
+small = jnp.zeros(2048*3, jnp.int32); tiny(small).block_until_ready()
+
+def bench_threads(nt, total=32):
+    hs = [tiny(small) for _ in range(total)]
+    for h in hs: h.copy_to_host_async()
+    t0 = time.perf_counter()
+    def work(chunk):
+        for h in chunk: np.asarray(h)
+    threads = [threading.Thread(target=work, args=(hs[i::nt],)) for i in range(nt)]
+    for t in threads: t.start()
+    for t in threads: t.join()
+    dt = time.perf_counter()-t0
+    print(f"{nt} threads, {total} fetches: {dt*1000:6.1f} ms total = {dt/total*1000:5.2f} ms/fetch")
+
+for nt in (1, 2, 4, 8):
+    bench_threads(nt)

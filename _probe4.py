@@ -1,0 +1,25 @@
+import time, threading, numpy as np, jax, jax.numpy as jnp
+
+@jax.jit
+def tiny(x): return x + 1
+small = jnp.zeros(2048*3, jnp.int32); tiny(small).block_until_ready()
+
+stop = False
+count = [0]
+def counter():
+    while not stop:
+        count[0] += 1
+
+# baseline counting rate
+t = threading.Thread(target=counter); t.start()
+time.sleep(1.0); stop = True; t.join()
+base_rate = count[0]
+print(f"counting alone: {base_rate/1e6:.2f} M/s")
+
+stop = False; count = [0]
+t = threading.Thread(target=counter); t.start()
+t0 = time.perf_counter(); n_f = 0
+while time.perf_counter() - t0 < 1.0:
+    h = tiny(small); h.copy_to_host_async(); np.asarray(h); n_f += 1
+stop = True; t.join()
+print(f"counting during fetches: {count[0]/1e6:.2f} M/s ({count[0]/base_rate*100:.0f}% of baseline), {n_f} fetches")

@@ -1,0 +1,59 @@
+import time, sys, numpy as np
+sys.path.insert(0, "/root/repo")
+import arroyo_tpu
+from arroyo_tpu import config as cfg
+arroyo_tpu._load_operators()
+cfg.update({"device.table-capacity": 65536, "checkpoint.storage-url": "/tmp/ck"})
+from arroyo_tpu.ops.slot_agg import SlotAggregator
+from arroyo_tpu import native
+
+agg = SlotAggregator(("max","count","max"), (np.int64,np.int64,np.int64),
+                     cap=65536, batch_cap=32768, emit_cap=8192, backend="jax",
+                     region_size=2048)
+rng = np.random.default_rng(0)
+B = 32768
+T = {}
+def tick(k, t0):
+    T[k] = T.get(k, 0.0) + (time.perf_counter() - t0)
+
+# synthetic q7-like stream: 3.3 bins per batch advancing, ~3.1k keys/bin
+for it in range(31):
+    base_bin = it * 33 // 10
+    keys = rng.integers(0, 3100, B).astype(np.uint64) + np.uint64(1000)
+    bins = (base_bin + rng.integers(0, 4, B)).astype(np.int32)
+    vals = [rng.integers(100, 10_000_000, B).astype(np.int64),
+            np.ones(B, dtype=np.int64), keys.view(np.int64).copy()]
+    t0 = time.perf_counter()
+    ku = np.ascontiguousarray(keys, dtype=np.uint64); ks = ku.view(np.int64)
+    b64 = np.ascontiguousarray(bins, dtype=np.int64)
+    tick("prep", t0)
+    d = agg.directory
+    t0 = time.perf_counter()
+    res = native.dir_resolve(ks, b64, d.hcode, d.hbin, d.hslot, d.boundary,
+                             d.slot_keys, d.slot_bins)
+    tick("dir_resolve", t0)
+    row_slots, miss_ord, mc, mk, mb = res
+    t0 = time.perf_counter()
+    if len(mc):
+        slots_new = d.lookup_or_assign(mc, mk, mb)
+        neg = row_slots < 0
+        row_slots[neg] = slots_new[miss_ord[neg]]
+    tick("alloc", t0)
+    t0 = time.perf_counter()
+    vs = [np.asarray(v, dtype=dt) for v, dt in zip(vals, agg.acc_dtypes)]
+    tick("vals", t0)
+    t0 = time.perf_counter()
+    agg.state = agg._step(agg.state, row_slots, tuple(vs))
+    tick("step_dispatch", t0)
+    # close a bin every ~3 batches like the real stream
+    if it % 3 == 2:
+        t0 = time.perf_counter()
+        h = agg.extract_start(0, base_bin, base_bin)
+        tick("extract_dispatch", t0)
+        t0 = time.perf_counter()
+        h.result()
+        tick("extract_fetch", t0)
+import jax
+jax.block_until_ready(agg.state)
+for k, v in T.items():
+    print(f"  {k:18s} {v*1000:8.1f} ms total  {v/31*1000:6.2f} ms/batch")

@@ -1,0 +1,75 @@
+import time, sys, numpy as np
+sys.path.insert(0, "/root/repo")
+import arroyo_tpu
+from arroyo_tpu import config as cfg
+import bench
+arroyo_tpu._load_operators()
+cfg.update({"pipeline.chaining.enabled": True, "device.table-capacity": 65536,
+            "device.emit-capacity": 8192, "worker.queue-size": 131072,
+            "checkpoint.storage-url": "/tmp/arroyo-tpu-bench/checkpoints"})
+
+from arroyo_tpu.ops import slot_agg as sa
+from arroyo_tpu import native
+T = {}
+def tick(k, t0):
+    T[k] = T.get(k, 0.0) + (time.perf_counter() - t0)
+
+orig_step_build = sa.SlotAggregator._update_chunk
+def timed_update(self, key_u64, bins, vals):
+    t0 = time.perf_counter()
+    m = len(key_u64)
+    ku = np.ascontiguousarray(key_u64, dtype=np.uint64); ks = ku.view(np.int64)
+    b64 = np.ascontiguousarray(bins, dtype=np.int64)
+    d = self.directory
+    tick("u.prep", t0); t0 = time.perf_counter()
+    res = native.dir_resolve(ks, b64, d.hcode, d.hbin, d.hslot, d.boundary,
+                             d.slot_keys, d.slot_bins)
+    tick("u.dir_resolve", t0); t0 = time.perf_counter()
+    row_slots, miss_ord, mc, mk, mb = res
+    if len(mc):
+        slots_new = d.lookup_or_assign(mc, mk, mb)
+        neg = row_slots < 0
+        row_slots[neg] = slots_new[miss_ord[neg]]
+    tick("u.alloc", t0); t0 = time.perf_counter()
+    spill_rows = row_slots < 0
+    assert not spill_rows.any()
+    B = self.batch_cap
+    if m == B:
+        slots = row_slots
+        vs = [np.asarray(v, dtype=dt) for v, dt in zip(vals, self.acc_dtypes)]
+    else:
+        slots = np.full(B, self.cap, dtype=np.int64); slots[:m] = row_slots
+        vs = []
+        for v, k_, dt in zip(vals, self.acc_kinds, self.acc_dtypes):
+            arr = np.full(B, sa._identity(k_, dt), dtype=dt); arr[:m] = v; vs.append(arr)
+    tick("u.pad", t0); t0 = time.perf_counter()
+    self.state = self._step(self.state, slots, tuple(vs))
+    tick("u.step_dispatch", t0)
+sa.SlotAggregator._update_chunk = timed_update
+
+orig_es = sa.SlotAggregator.extract_start
+def timed_es(self, *a):
+    t0 = time.perf_counter()
+    r = orig_es(self, *a)
+    tick("extract_dispatch", t0)
+    return r
+sa.SlotAggregator.extract_start = timed_es
+
+from arroyo_tpu.windows import tumbling as tw
+for name, key in [("process_batch", "agg.process"), ("_drain_pending", "agg.drain")]:
+    orig = getattr(tw.TumblingAggregate, name)
+    def mk(orig, key):
+        def f(self, *a, **k):
+            t0 = time.perf_counter()
+            r = orig(self, *a, **k)
+            tick(key, t0)
+            return r
+        return f
+    setattr(tw.TumblingAggregate, name, mk(orig, key))
+
+bench.run_once("jax", 50_000, batch_size=32768)
+T.clear()
+wall, n, rows = bench.run_once("jax", 2_000_000, batch_size=32768)
+print(f"{n} events in {wall:.2f}s = {n/wall:,.0f} ev/s")
+for k, v in sorted(T.items(), key=lambda kv: -kv[1]):
+    print(f"  {k:20s} {v*1000:8.1f} ms")

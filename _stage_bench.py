@@ -1,0 +1,49 @@
+import time, sys, numpy as np
+import arroyo_tpu
+from arroyo_tpu import config as cfg
+sys.path.insert(0, "/root/repo")
+import bench
+
+arroyo_tpu._load_operators()
+cfg.update({
+    "pipeline.source-batch-size": 32768,
+    "pipeline.chaining.enabled": True,
+    "device.batch-capacity": 32768,
+    "device.table-capacity": 65536,
+    "device.emit-capacity": 8192,
+    "checkpoint.storage-url": "/tmp/arroyo-tpu-bench/checkpoints",
+})
+
+T = {}
+def wrap(obj, name, key):
+    orig = getattr(obj, name)
+    def timed(*a, **k):
+        t0 = time.perf_counter()
+        r = orig(*a, **k)
+        T[key] = T.get(key, 0.0) + (time.perf_counter() - t0)
+        return r
+    setattr(obj, name, timed)
+
+from arroyo_tpu.connectors import nexmark as nx
+from arroyo_tpu.windows import tumbling as tw
+from arroyo_tpu.ops import slot_agg as sa
+from arroyo_tpu.operators import builtin as bi
+
+wrap(nx.NexmarkSource, "_generate", "source_generate")
+wrap(bi.ValueOperator, "process_batch", "value_op_total")
+wrap(bi.KeyOperator, "process_batch", "key_op_total")
+wrap(tw.TumblingAggregate, "process_batch", "agg_process_total")
+wrap(sa.SlotAggregator, "_update_chunk", "agg_update_chunk")
+wrap(sa.BinSlotDirectory, "lookup_or_assign", "dir_lookup")
+wrap(sa.SlotAggregator, "extract_start", "close_dispatch")
+wrap(sa.SlotExtractHandle, "result", "close_fetch_materialize")
+wrap(tw.TumblingAggregate, "_emit_entries", "emit_entries")
+
+# warmup
+bench.run_once("jax", 50_000, batch_size=32768)
+T.clear()
+wall, n, rows = bench.run_once("jax", 1_000_000, batch_size=32768)
+print(f"\n{n} events in {wall:.2f}s = {n/wall:,.0f} ev/s")
+# note: nested keys overlap (update_chunk inside agg_process etc.)
+for k, v in sorted(T.items(), key=lambda kv: -kv[1]):
+    print(f"  {k:26s} {v*1000:8.1f} ms")

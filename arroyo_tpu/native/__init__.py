@@ -77,6 +77,15 @@ def _declare(l: ctypes.CDLL) -> None:
     l.ah_hash_f64.argtypes = [f64p, u64p, ctypes.c_int64]
     l.ah_partition.argtypes = [u64p, ctypes.c_int64, ctypes.c_int32, i64p, i64p]
     l.ah_partition.restype = ctypes.c_int
+    l.ah_dir_resolve.argtypes = [
+        i64p, i64p, ctypes.c_int64,          # keys, bins, n
+        u64p, i64p, i64p,                    # hcode, hbin, hslot
+        ctypes.c_int64, ctypes.c_int64,      # hcap, boundary
+        i64p, i64p,                          # slot_keys, slot_bins
+        i64p, i64p,                          # out_slots, miss_ord
+        u64p, i64p, i64p,                    # miss_codes, miss_keys, miss_bins
+    ]
+    l.ah_dir_resolve.restype = ctypes.c_int64
     l.ah_parse_json_lines.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
@@ -164,6 +173,43 @@ def partition(hashes: np.ndarray, n_dest: int):
     if rc != 0:
         return None
     return perm, offsets
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def dir_resolve(keys: np.ndarray, bins: np.ndarray, hcode: np.ndarray,
+                hbin: np.ndarray, hslot: np.ndarray, boundary: int,
+                slot_keys: np.ndarray, slot_bins: np.ndarray):
+    """Single-pass (key,bin)->slot resolution against the slot directory's
+    open-addressing arrays (see cpp ah_dir_resolve). Returns (slots,
+    miss_ord, miss_codes, miss_keys, miss_bins) or None when the native
+    library is unavailable. Raises on 64-bit code collision, matching
+    BinSlotDirectory.lookup_or_assign."""
+    l = lib()
+    if l is None:
+        return None
+    n = len(keys)
+    out_slots = np.empty(n, dtype=np.int64)
+    miss_ord = np.empty(n, dtype=np.int64)
+    miss_codes = np.empty(n, dtype=np.uint64)
+    miss_keys = np.empty(n, dtype=np.int64)
+    miss_bins = np.empty(n, dtype=np.int64)
+    rc = l.ah_dir_resolve(
+        _i64p(keys), _i64p(bins), n,
+        _u64p(hcode), _i64p(hbin), _i64p(hslot),
+        len(hcode), boundary,
+        _i64p(slot_keys), _i64p(slot_bins),
+        _i64p(out_slots), _i64p(miss_ord),
+        _u64p(miss_codes), _i64p(miss_keys), _i64p(miss_bins),
+    )
+    if rc == -2:
+        raise RuntimeError("64-bit (bin,key) code collision in slot directory")
+    if rc < 0:
+        return None
+    m = int(rc)
+    return out_slots, miss_ord, miss_codes[:m], miss_keys[:m], miss_bins[:m]
 
 
 # -------------------------------------------------------------- JSON lines

@@ -1,0 +1,100 @@
+"""Background materialization of device->host fetches.
+
+Over a remote-device link (the TPU tunnel; same shape as a DCN-attached
+host), a device->host fetch costs a full round trip even when the copy was
+started with ``copy_to_host_async`` — measured 15-60 ms per sync point on
+the driver tunnel regardless of buffer size. Materializing on the operator
+thread therefore stalls the hot loop once per window close.
+
+This module gives operators a single shared fetch thread: extraction handles
+are submitted right after dispatch, the worker thread blocks on the round
+trip (numpy/jax release the GIL during the transfer), and the operator polls
+``Future.is_ready()`` — a plain Event check — emitting completed closes in
+order. The reference has no analog (its operators and state share one
+address space); this is the host-runtime half of SURVEY §7's "host-side
+async stages feeding device steps".
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+
+class Future:
+    def __init__(self, fn: Callable):
+        self._fn = fn
+        self._done = threading.Event()
+        self._value = None
+        self._exc: Optional[BaseException] = None
+
+    def is_ready(self) -> bool:
+        return self._done.is_set()
+
+    def result(self):
+        self._done.wait()
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def _run(self) -> None:
+        try:
+            self._value = self._fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised at result()
+            self._exc = e
+        self._done.set()
+
+
+class Prefetcher:
+    """A small daemon pool draining a submit queue. Concurrent fetches
+    overlap their round trips on the device link (measured ~6x on the
+    driver tunnel: 16 ms/fetch serial -> 2.5 ms/fetch at 4 workers), so
+    multiple workers matter even though each just blocks on a copy.
+    Submitted callables must not mutate shared aggregator state
+    (SlotExtractHandle.result reads only snapshotted identities + device
+    buffers); completion order is unconstrained — consumers pop their own
+    queues in program order and check ``is_ready`` per future."""
+
+    def __init__(self, workers: int = 4):
+        self._q: "queue.Queue[Future]" = queue.Queue()
+        self._workers = workers
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+
+    def _ensure_threads(self) -> None:
+        if len(self._threads) < self._workers:
+            with self._lock:
+                while len(self._threads) < self._workers:
+                    t = threading.Thread(
+                        target=self._loop,
+                        name=f"arroyo-prefetch-{len(self._threads)}",
+                        daemon=True,
+                    )
+                    t.start()
+                    self._threads.append(t)
+
+    def _loop(self) -> None:
+        while True:
+            self._q.get()._run()
+
+    def submit(self, fn: Callable) -> Future:
+        self._ensure_threads()
+        fut = Future(fn)
+        self._q.put(fut)
+        return fut
+
+
+_shared: Optional[Prefetcher] = None
+_shared_lock = threading.Lock()
+
+
+def shared_prefetcher() -> Prefetcher:
+    global _shared
+    if _shared is None:
+        with _shared_lock:
+            if _shared is None:
+                from ..config import config
+
+                _shared = Prefetcher(config().get("device.prefetch-workers", 2))
+    return _shared

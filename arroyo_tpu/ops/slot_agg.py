@@ -390,13 +390,28 @@ class SlotAggregator(DeviceHashAggregator):
 
     def _update_chunk(self, key_u64, bins, vals) -> None:
         m = len(key_u64)
-        ku = key_u64.astype(np.uint64)
+        ku = np.ascontiguousarray(key_u64, dtype=np.uint64)
         ks = ku.view(np.int64)
-        b64 = np.asarray(bins).astype(np.int64)
-        codes = splitmix64(ku ^ (b64.astype(np.uint64) * _BIN_MIX))
-        uniq, first, inv = np.unique(codes, return_index=True, return_inverse=True)
-        slots_u = self.directory.lookup_or_assign(uniq, ks[first], b64[first])
-        row_slots = slots_u[inv]
+        b64 = np.ascontiguousarray(bins, dtype=np.int64)
+        d = self.directory
+        from .. import native
+
+        res = native.dir_resolve(ks, b64, d.hcode, d.hbin, d.hslot,
+                                 d.boundary, d.slot_keys, d.slot_bins)
+        if res is not None:
+            # native fast path: one C pass resolves every row whose (bin,key)
+            # group already owns a slot; only first-seen groups (deduplicated
+            # in C) go through the Python allocator
+            row_slots, miss_ord, miss_codes, miss_keys, miss_bins = res
+            if len(miss_codes):
+                slots_new = d.lookup_or_assign(miss_codes, miss_keys, miss_bins)
+                neg = row_slots < 0
+                row_slots[neg] = slots_new[miss_ord[neg]]
+        else:
+            codes = splitmix64(ku ^ (b64.astype(np.uint64) * _BIN_MIX))
+            uniq, first, inv = np.unique(codes, return_index=True, return_inverse=True)
+            slots_u = self.directory.lookup_or_assign(uniq, ks[first], b64[first])
+            row_slots = slots_u[inv]
         vals = [np.asarray(v) for v in vals]
         spill_rows = row_slots < 0
         if spill_rows.any():
@@ -407,13 +422,18 @@ class SlotAggregator(DeviceHashAggregator):
             vals = [v[keep] for v in vals]
             m = len(keep)
         B = self.batch_cap
-        slots = np.full(B, self.cap, dtype=np.int64)  # pad -> dropped
-        slots[:m] = row_slots
-        vs = []
-        for v, k, dt in zip(vals, self.acc_kinds, self.acc_dtypes):
-            arr = np.full(B, _identity(k, dt), dtype=dt)
-            arr[:m] = v
-            vs.append(arr)
+        if m == B:
+            # full-width chunk (steady state): no padding copies needed
+            slots = row_slots
+            vs = [np.asarray(v, dtype=dt) for v, dt in zip(vals, self.acc_dtypes)]
+        else:
+            slots = np.full(B, self.cap, dtype=np.int64)  # pad -> dropped
+            slots[:m] = row_slots
+            vs = []
+            for v, k, dt in zip(vals, self.acc_kinds, self.acc_dtypes):
+                arr = np.full(B, _identity(k, dt), dtype=dt)
+                arr[:m] = v
+                vs.append(arr)
         self.state = self._step(self.state, slots, tuple(vs))
 
     def _spill_update(self, keys_i64, bins_i64, vals) -> None:

@@ -36,12 +36,10 @@ from ..types import Signal, Watermark
 WINDOW_START = "window_start"
 WINDOW_END = "window_end"
 
-# in-flight window-close extraction policy: a close is fetched once it has
-# aged _DRAIN_AGE batches (the platform's is_ready() is unreliable over the
-# remote-device tunnel, so age is the readiness proxy) or when the queue
-# exceeds _PIPELINE_DEPTH
-_PIPELINE_DEPTH = 8
-_DRAIN_AGE = 3
+# in-flight window-close policy: extraction results materialize on the
+# shared prefetch thread (ops/prefetch.py) so the hot loop never blocks on a
+# device->host round trip; the queue force-drains past _PIPELINE_DEPTH
+_PIPELINE_DEPTH = 16
 
 
 def acc_plan(aggregates: list[tuple[str, str, Optional[Expr]]], schema_dtype_of) -> tuple:
@@ -269,20 +267,12 @@ class TumblingAggregate(Operator):
         broadcasts only after its rows, preserving downstream lateness
         semantics."""
         while self._pending:
-            handle, rel_before, wm, seq = self._pending[0]
-            if handle is not None and not force:
-                aged = self._batch_seq - seq >= _DRAIN_AGE
-                ready = False
-                if not aged:
-                    try:
-                        ready = handle.is_ready()
-                    except AttributeError:
-                        ready = True
-                if not (aged or ready):
-                    return
+            fut, rel_before, wm, _seq = self._pending[0]
+            if fut is not None and not force and not fut.is_ready():
+                return
             self._pending.popleft()
-            if handle is not None:
-                keys, bins, accs = handle.result()
+            if fut is not None:
+                keys, bins, accs = fut.result()
                 if len(keys):
                     self._emit_entries(keys, bins, accs, collector)
                 if self.dict_key_fields:
@@ -321,6 +311,13 @@ class TumblingAggregate(Operator):
         True when held, False when the caller should forward it."""
         if out_wm is None or not self._pending:
             return False
+        tail = self._pending[-1]
+        if tail[0] is None and tail[2] is not None:
+            # consecutive watermarks with no rows between them collapse to
+            # the newest — only the latest matters downstream, and appending
+            # each would churn the depth bound into needless force-drains
+            self._pending[-1] = (None, None, out_wm, tail[3])
+            return True
         if len(self._pending) >= _PIPELINE_DEPTH:
             self._drain_pending(collector, force=True)
             return False
@@ -354,7 +351,10 @@ class TumblingAggregate(Operator):
         if len(self._pending) >= _PIPELINE_DEPTH:
             self._drain_pending(collector, force=True)
         handle = agg.extract_start(min(closing), rel_before, rel_before)
-        self._pending.append((handle, rel_before, out_wm, self._batch_seq))
+        from ..ops.prefetch import shared_prefetcher
+
+        fut = shared_prefetcher().submit(handle.result)
+        self._pending.append((fut, rel_before, out_wm, self._batch_seq))
         return True
 
     def _emit_entries(self, keys, bins, accs, collector) -> None:

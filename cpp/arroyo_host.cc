@@ -106,6 +106,109 @@ int ah_partition(const uint64_t* hashes, int64_t n_rows, int32_t n_dest,
   return 0;
 }
 
+// -------------------------------------------------------- slot directory
+
+// One-pass resolve + allocate over the BinSlotDirectory (arroyo_tpu/ops/
+// slot_agg.py). The directory is an interleaved open-addressing table
+// htab[h] = {code (u64 bits), bin, slot} — one cache line per probe instead
+// of three parallel arrays. Probe semantics mirror the numpy fallback
+// lookup_or_assign: code = splitmix64(key ^ bin*C1); a live entry
+// (slot >= 0 && bin >= boundary) with matching code resolves (identity-
+// checked; mismatch = collision -> -2); the first non-live entry is where a
+// new (bin, key) group claims.
+//
+// A claim allocates the next device slot from the bin's open region,
+// chaining new regions from the free stack; region grants are appended to
+// new_regions_{bin,id} in order so Python can mirror them into its
+// bin_regions map. When the free stack runs dry the remaining new groups
+// stay at -1 (host spill tier). Returns the spill-row count, or -2 on
+// collision.
+struct OpenBin { int64_t bin; int64_t region; };
+
+int64_t ah_dir_update(
+    const int64_t* keys, const int64_t* bins, int64_t n,
+    int64_t* htab, int64_t hcap, int64_t boundary, int64_t dead_bin,
+    int64_t* slot_keys, int64_t* slot_bins,
+    int64_t region_size,
+    int64_t* region_fill,
+    int64_t* free_stack, int64_t* free_top_io,
+    const int64_t* live_bins, const int64_t* live_last_region, int64_t n_live,
+    int64_t* out_slots,
+    int64_t* new_regions_bin, int64_t* new_regions_id, int64_t* n_new_io) {
+  const uint64_t hmask = (uint64_t)hcap - 1;
+  int64_t free_top = *free_top_io;
+  int64_t n_new = 0;
+  int64_t n_spill = 0;
+  // open-region map for the bins touched by this batch (a handful)
+  OpenBin open[256];
+  int n_open = 0;
+  for (int64_t i = 0; i < n_live && i < 256; i++) {
+    open[n_open].bin = live_bins[i];
+    open[n_open].region = live_last_region[i];
+    n_open++;
+  }
+  for (int64_t i = 0; i < n; i++) {
+    const int64_t key = keys[i];
+    const int64_t bin = bins[i];
+    const uint64_t code = splitmix64((uint64_t)key ^ ((uint64_t)bin * C1));
+    uint64_t h = code & hmask;
+    int64_t slot = -1;
+    int64_t claim_at = -1;
+    for (int64_t step = 0; step < hcap; step++) {
+      int64_t* e = htab + h * 3;
+      if (e[2] < 0 || e[1] < boundary) { claim_at = (int64_t)h; break; }
+      if ((uint64_t)e[0] == code) {
+        const int64_t s = e[2];
+        if (slot_keys[s] != key || slot_bins[s] != bin) return -2;
+        slot = s;
+        break;
+      }
+      h = (h + 1) & hmask;
+    }
+    if (slot < 0 && claim_at >= 0) {
+      // find / create the bin's open region
+      int oi = -1;
+      for (int j = 0; j < n_open; j++)
+        if (open[j].bin == bin) { oi = j; break; }
+      if (oi < 0 && n_open < 256) {
+        oi = n_open++;
+        open[oi].bin = bin;
+        open[oi].region = -1;
+      }
+      if (oi >= 0) {
+        int64_t r = open[oi].region;
+        if (r < 0 || region_fill[r] >= region_size) {
+          if (free_top > 0) {
+            r = free_stack[--free_top];
+            region_fill[r] = 0;
+            open[oi].region = r;
+            new_regions_bin[n_new] = bin;
+            new_regions_id[n_new] = r;
+            n_new++;
+          } else {
+            r = -1;  // exhausted: spill
+          }
+        }
+        if (r >= 0) {
+          slot = r * region_size + region_fill[r]++;
+          slot_keys[slot] = key;
+          slot_bins[slot] = bin;
+          int64_t* e = htab + claim_at * 3;
+          e[0] = (int64_t)code;
+          e[1] = bin;
+          e[2] = slot;
+        }
+      }
+    }
+    out_slots[i] = slot;
+    if (slot < 0) n_spill++;
+  }
+  (void)dead_bin;
+  *free_top_io = free_top;
+  *n_new_io = n_new;
+  return n_spill;
+}
+
 // ------------------------------------------------------------- JSON lines
 //
 // Flat-object parser for a fixed schema. Column kinds:

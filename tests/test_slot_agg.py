@@ -185,3 +185,42 @@ def test_scan_range_nondestructive_with_spill():
     t2 = _table(*agg.scan_range(0, 1))
     assert t1 == t2 and len(t1) == n_keys
     assert agg.spill  # scan must not consume spill entries
+
+
+def test_native_dir_resolve_matches_numpy_fallback():
+    """The C++ ah_dir_resolve fast path and the pure-numpy unique+probe path
+    must produce identical aggregation results (same directory semantics,
+    including claims after closes raising the boundary)."""
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(11)
+    streams = [
+        (rng.integers(0, 90, 120).astype(np.uint64),
+         rng.integers(s // 4, s // 4 + 2, 120).astype(np.int32),
+         rng.integers(1, 100, 120).astype(np.int64))
+        for s in range(24)
+    ]
+
+    def run(disable_native):
+        if disable_native:
+            cfg.update({"native.enabled": False})
+            native._lib = None
+            native._lib_failed = True
+        agg = _mk()
+        out = {}
+        for s, (keys, bins, vals) in enumerate(streams):
+            agg.update(keys, bins, [np.ones(len(keys), dtype=np.int64), vals])
+            if s % 4 == 3:
+                k, b, accs = agg.extract(0, s // 4 + 1, s // 4 + 1)
+                out.update(_table(k, b, accs))
+        k, b, accs = agg.extract(0, 1 << 30, 1 << 30)
+        out.update(_table(k, b, accs))
+        if disable_native:
+            native._lib_failed = False
+            cfg.update({"native.enabled": True})
+        return out
+
+    assert run(False) == run(True)
