@@ -62,7 +62,13 @@ def lib() -> Optional[ctypes.CDLL]:
         except OSError:
             _lib_failed = True
             return None
-        _declare(l)
+        try:
+            _declare(l)
+        except AttributeError:
+            # missing/renamed symbol (stale or incompatible .so): honor the
+            # module contract — degrade to the NumPy fallbacks, never crash
+            _lib_failed = True
+            return None
         _lib = l
         return _lib
 

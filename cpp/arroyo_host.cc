@@ -108,105 +108,77 @@ int ah_partition(const uint64_t* hashes, int64_t n_rows, int32_t n_dest,
 
 // -------------------------------------------------------- slot directory
 
-// One-pass resolve + allocate over the BinSlotDirectory (arroyo_tpu/ops/
-// slot_agg.py). The directory is an interleaved open-addressing table
-// htab[h] = {code (u64 bits), bin, slot} — one cache line per probe instead
-// of three parallel arrays. Probe semantics mirror the numpy fallback
-// lookup_or_assign: code = splitmix64(key ^ bin*C1); a live entry
-// (slot >= 0 && bin >= boundary) with matching code resolves (identity-
-// checked; mismatch = collision -> -2); the first non-live entry is where a
-// new (bin, key) group claims.
+// One-pass resolve over the BinSlotDirectory's open-addressing arrays
+// (arroyo_tpu/ops/slot_agg.py BinSlotDirectory: hcode/hbin/hslot parallel
+// arrays). Probe semantics mirror the numpy fallback lookup_or_assign:
+// code = splitmix64(key ^ bin*C1); a live entry (hslot >= 0 && hbin >=
+// boundary) with matching code resolves (identity-checked against
+// slot_keys/slot_bins; mismatch = 64-bit collision -> -2); the first
+// non-live probe position means the group has no slot yet -> MISS.
 //
-// A claim allocates the next device slot from the bin's open region,
-// chaining new regions from the free stack; region grants are appended to
-// new_regions_{bin,id} in order so Python can mirror them into its
-// bin_regions map. When the free stack runs dry the remaining new groups
-// stay at -1 (host spill tier). Returns the spill-row count, or -2 on
-// collision.
-struct OpenBin { int64_t bin; int64_t region; };
-
-int64_t ah_dir_update(
+// Misses are deduplicated by code in stream order: out_slots[i] = -1 and
+// miss_ord[i] = index into miss_codes/miss_keys/miss_bins (length = return
+// value) so Python can allocate each first-seen group exactly once via
+// BinSlotDirectory.lookup_or_assign and scatter the new slots back through
+// miss_ord. Returns the miss count, -2 on identity collision, -3 when a
+// probe wraps the full table (caller falls back to numpy).
+int64_t ah_dir_resolve(
     const int64_t* keys, const int64_t* bins, int64_t n,
-    int64_t* htab, int64_t hcap, int64_t boundary, int64_t dead_bin,
-    int64_t* slot_keys, int64_t* slot_bins,
-    int64_t region_size,
-    int64_t* region_fill,
-    int64_t* free_stack, int64_t* free_top_io,
-    const int64_t* live_bins, const int64_t* live_last_region, int64_t n_live,
-    int64_t* out_slots,
-    int64_t* new_regions_bin, int64_t* new_regions_id, int64_t* n_new_io) {
+    const uint64_t* hcode, const int64_t* hbin, const int64_t* hslot,
+    int64_t hcap, int64_t boundary,
+    const int64_t* slot_keys, const int64_t* slot_bins,
+    int64_t* out_slots, int64_t* miss_ord,
+    uint64_t* miss_codes, int64_t* miss_keys, int64_t* miss_bins) {
   const uint64_t hmask = (uint64_t)hcap - 1;
-  int64_t free_top = *free_top_io;
-  int64_t n_new = 0;
-  int64_t n_spill = 0;
-  // open-region map for the bins touched by this batch (a handful)
-  OpenBin open[256];
-  int n_open = 0;
-  for (int64_t i = 0; i < n_live && i < 256; i++) {
-    open[n_open].bin = live_bins[i];
-    open[n_open].region = live_last_region[i];
-    n_open++;
-  }
+  // local dedup table for missed codes (ord = -1 marks empty)
+  int64_t dcap = 64;
+  while (dcap < 2 * n) dcap <<= 1;
+  const uint64_t dmask = (uint64_t)dcap - 1;
+  uint64_t* dcode = (uint64_t*)malloc(sizeof(uint64_t) * dcap);
+  int64_t* dord = (int64_t*)malloc(sizeof(int64_t) * dcap);
+  if (!dcode || !dord) { free(dcode); free(dord); return -4; }
+  for (int64_t j = 0; j < dcap; j++) dord[j] = -1;
+  int64_t m = 0;
+  int64_t rc = 0;
   for (int64_t i = 0; i < n; i++) {
     const int64_t key = keys[i];
     const int64_t bin = bins[i];
     const uint64_t code = splitmix64((uint64_t)key ^ ((uint64_t)bin * C1));
     uint64_t h = code & hmask;
     int64_t slot = -1;
-    int64_t claim_at = -1;
-    for (int64_t step = 0; step < hcap; step++) {
-      int64_t* e = htab + h * 3;
-      if (e[2] < 0 || e[1] < boundary) { claim_at = (int64_t)h; break; }
-      if ((uint64_t)e[0] == code) {
-        const int64_t s = e[2];
-        if (slot_keys[s] != key || slot_bins[s] != bin) return -2;
+    bool miss = false;
+    int64_t step = 0;
+    for (; step < hcap; step++) {
+      if (hslot[h] < 0 || hbin[h] < boundary) { miss = true; break; }
+      if (hcode[h] == code) {
+        const int64_t s = hslot[h];
+        if (slot_keys[s] != key || slot_bins[s] != bin) { rc = -2; goto done; }
         slot = s;
         break;
       }
       h = (h + 1) & hmask;
     }
-    if (slot < 0 && claim_at >= 0) {
-      // find / create the bin's open region
-      int oi = -1;
-      for (int j = 0; j < n_open; j++)
-        if (open[j].bin == bin) { oi = j; break; }
-      if (oi < 0 && n_open < 256) {
-        oi = n_open++;
-        open[oi].bin = bin;
-        open[oi].region = -1;
+    if (slot < 0 && !miss) { rc = -3; goto done; }  // table wrapped
+    if (miss) {
+      uint64_t dh = code & dmask;
+      while (dord[dh] >= 0 && dcode[dh] != code) dh = (dh + 1) & dmask;
+      if (dord[dh] < 0) {
+        dcode[dh] = code;
+        dord[dh] = m;
+        miss_codes[m] = code;
+        miss_keys[m] = key;
+        miss_bins[m] = bin;
+        m++;
       }
-      if (oi >= 0) {
-        int64_t r = open[oi].region;
-        if (r < 0 || region_fill[r] >= region_size) {
-          if (free_top > 0) {
-            r = free_stack[--free_top];
-            region_fill[r] = 0;
-            open[oi].region = r;
-            new_regions_bin[n_new] = bin;
-            new_regions_id[n_new] = r;
-            n_new++;
-          } else {
-            r = -1;  // exhausted: spill
-          }
-        }
-        if (r >= 0) {
-          slot = r * region_size + region_fill[r]++;
-          slot_keys[slot] = key;
-          slot_bins[slot] = bin;
-          int64_t* e = htab + claim_at * 3;
-          e[0] = (int64_t)code;
-          e[1] = bin;
-          e[2] = slot;
-        }
-      }
+      miss_ord[i] = dord[dh];
     }
     out_slots[i] = slot;
-    if (slot < 0) n_spill++;
   }
-  (void)dead_bin;
-  *free_top_io = free_top;
-  *n_new_io = n_new;
-  return n_spill;
+  rc = m;
+done:
+  free(dcode);
+  free(dord);
+  return rc;
 }
 
 // ------------------------------------------------------------- JSON lines

@@ -43,3 +43,8 @@ def _operators():
     import arroyo_tpu
 
     arroyo_tpu._load_operators()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "no_native_required: runs even when the native library is unavailable")

@@ -24,11 +24,57 @@ from arroyo_tpu.native.wire import (
 )
 from arroyo_tpu.types import CheckpointBarrier, Signal, Watermark
 
-pytestmark = pytest.mark.skipif(
-    not native.available(), reason="native library unavailable (no g++?)"
-)
+# Lazily skip at setup time, NOT at collection time: native.available()
+# builds+loads the .so, and a native-layer fault at import poisoned the
+# whole suite in round 3 (VERDICT.md). A fixture keeps collection pure.
+@pytest.fixture(autouse=True)
+def _require_native(request):
+    if request.node.get_closest_marker("no_native_required"):
+        return
+    if not native.available():
+        pytest.skip("native library unavailable (no g++?)")
 
 rng = np.random.default_rng(7)
+
+
+@pytest.mark.no_native_required
+def test_incompatible_so_falls_back_to_numpy(tmp_path):
+    """A library that loads but is missing symbols (stale/half-built .so —
+    the exact failure mode that shipped in round 3) must degrade to the
+    NumPy fallback, not crash. No fixture: this test must run even when the
+    real library is unavailable."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    if shutil.which("g++") is None:
+        pytest.skip("g++ unavailable")
+    src = tmp_path / "empty.cc"
+    src.write_text('extern "C" { void ah_not_the_api(void) {} }\n')
+    so = tmp_path / "libarroyo_host.so"
+    subprocess.run(
+        ["g++", "-shared", "-fPIC", "-o", str(so), str(src)], check=True
+    )
+    code = (
+        "import arroyo_tpu.native as n\n"
+        f"n._LIB_PATH = {str(so)!r}\n"
+        "n._CPP_DIR = ''\n"  # no sources next to it -> no rebuild attempt
+        "assert n.lib() is None\n"
+        "assert not n.available()\n"
+        "import numpy as np\n"
+        "from arroyo_tpu.hashing import hash_columns\n"
+        "h = hash_columns([np.arange(10, dtype=np.int64)])\n"
+        "assert h.shape == (10,)\n"
+        "print('FALLBACK_OK')\n"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=repo_root, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "FALLBACK_OK" in r.stdout
 
 
 def test_hash_u64_matches_numpy():

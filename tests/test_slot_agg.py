@@ -205,22 +205,25 @@ def test_native_dir_resolve_matches_numpy_fallback():
     ]
 
     def run(disable_native):
-        if disable_native:
-            cfg.update({"native.enabled": False})
-            native._lib = None
-            native._lib_failed = True
-        agg = _mk()
-        out = {}
-        for s, (keys, bins, vals) in enumerate(streams):
-            agg.update(keys, bins, [np.ones(len(keys), dtype=np.int64), vals])
-            if s % 4 == 3:
-                k, b, accs = agg.extract(0, s // 4 + 1, s // 4 + 1)
-                out.update(_table(k, b, accs))
-        k, b, accs = agg.extract(0, 1 << 30, 1 << 30)
-        out.update(_table(k, b, accs))
-        if disable_native:
-            native._lib_failed = False
-            cfg.update({"native.enabled": True})
-        return out
+        saved = native._lib, native._lib_failed
+        saved_enabled = cfg.config().get("native.enabled", True)
+        try:
+            if disable_native:
+                cfg.update({"native.enabled": False})
+                native._lib = None
+                native._lib_failed = True
+            agg = _mk()
+            out = {}
+            for s, (keys, bins, vals) in enumerate(streams):
+                agg.update(keys, bins, [np.ones(len(keys), dtype=np.int64), vals])
+                if s % 4 == 3:
+                    k, b, accs = agg.extract(0, s // 4 + 1, s // 4 + 1)
+                    out.update(_table(k, b, accs))
+            k, b, accs = agg.extract(0, 1 << 30, 1 << 30)
+            out.update(_table(k, b, accs))
+            return out
+        finally:
+            native._lib, native._lib_failed = saved
+            cfg.update({"native.enabled": saved_enabled})
 
     assert run(False) == run(True)
