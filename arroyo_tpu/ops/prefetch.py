@@ -96,5 +96,5 @@ def shared_prefetcher() -> Prefetcher:
             if _shared is None:
                 from ..config import config
 
-                _shared = Prefetcher(config().get("device.prefetch-workers", 2))
+                _shared = Prefetcher(config().get("device.prefetch-workers", 8))
     return _shared

@@ -44,6 +44,7 @@ import numpy as np
 
 from ..hashing import splitmix64
 from .aggregate import (
+    _I32_MAX,
     DeviceHashAggregator,
     _identity,
     combine_by_key_bin,
@@ -212,7 +213,32 @@ class SlotExtractHandle:
             for (_regs, ib, fb) in self._groups
         )
 
+    def _wait_ready(self, deadline_s: float = 30.0) -> None:
+        """Poll is_ready before materializing. Blocking np.asarray on a
+        buffer whose async copy is still in flight hits a pathological
+        multi-second stall on the remote-device tunnel (measured: avg 1.8 s
+        vs ~70 ms copy latency when polled); a 1 ms is_ready poll loop
+        materializes in 0.1 ms once the copy lands. Bounded: past the
+        deadline we fall through to the blocking asarray, which still
+        raises if the device/link actually failed (a bare poll loop would
+        spin forever on a dead tunnel)."""
+        import time
+
+        limit = time.monotonic() + deadline_s
+        for _regs, ib, fb in self._groups:
+            for buf in (ib, fb):
+                if buf is None:
+                    continue
+                try:
+                    while not buf.is_ready():
+                        if time.monotonic() > limit:
+                            return
+                        time.sleep(0.001)
+                except AttributeError:
+                    return  # backend without is_ready: fall through to asarray
+
     def result(self) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
+        self._wait_ready()
         agg = self._agg
         R = agg.region_size
         int_idx = [i for i, d in enumerate(agg.acc_dtypes)
@@ -271,16 +297,34 @@ def _build_slot_jax(acc_kinds: tuple, acc_dtypes: tuple, cap: int, region_size: 
         for k, d in zip(acc_kinds, acc_dtypes)
     )
 
-    def step(state, slots, vals):
-        out = []
-        for kind, a, v in zip(acc_kinds, state, vals):
-            if kind in ("sum", "count"):
-                out.append(a.at[slots].add(v, mode="drop"))
-            elif kind == "min":
-                out.append(a.at[slots].min(v, mode="drop"))
-            else:
-                out.append(a.at[slots].max(v, mode="drop"))
-        return tuple(out)
+    def _mk_step(merge: bool):
+        # hot path (merge=False): count lanes take no val array — the
+        # increment is a constant 1, so shipping a batch-length ones lane
+        # over the host->device link (256 KB/batch at 32k rows) would be
+        # pure waste. Merge mode (restore / partial-combine) scatters the
+        # provided partial counts instead; it compiles lazily on first
+        # restore, never in the steady state.
+        def step(state, slots, vals):
+            out = []
+            vi = 0
+            for kind, a in zip(acc_kinds, state):
+                if kind == "count" and not merge:
+                    out.append(a.at[slots].add(np.asarray(1, a.dtype), mode="drop"))
+                    continue
+                v = vals[vi]
+                vi += 1
+                if kind in ("sum", "count"):
+                    out.append(a.at[slots].add(v, mode="drop"))
+                elif kind == "min":
+                    out.append(a.at[slots].min(v, mode="drop"))
+                else:
+                    out.append(a.at[slots].max(v, mode="drop"))
+            return tuple(out)
+
+        return step
+
+    step = _mk_step(merge=False)
+    step_merge = _mk_step(merge=True)
 
     # 64-bit bitcasts are unsupported under TPU x64 emulation, so integer and
     # float accumulators travel in two separately-typed buffers (still one
@@ -333,6 +377,7 @@ def _build_slot_jax(acc_kinds: tuple, acc_dtypes: tuple, cap: int, region_size: 
 
     return (
         jax.jit(step, donate_argnums=0),
+        jax.jit(step_merge, donate_argnums=0),
         make_read_multi,
         jax.jit(clear, donate_argnums=0),
     )
@@ -364,9 +409,9 @@ class SlotAggregator(DeviceHashAggregator):
             self.max_probes = max_probes
             self.emit_cap = emit_cap
             self.backend = backend
-            (self._step, self._read_multi, self._clear) = _build_slot_jax(
-                self.acc_kinds, self.acc_dtypes, cap, region_size
-            )
+            (self._step, self._step_merge, self._read_multi, self._clear) = \
+                _build_slot_jax(self.acc_kinds, self.acc_dtypes, cap, region_size)
+            self._merge_mode = False
             self._n_flt_lanes = sum(
                 1 for d in self.acc_dtypes if np.issubdtype(d, np.floating))
             self._n_int_lanes = len(self.acc_dtypes) - self._n_flt_lanes
@@ -422,19 +467,28 @@ class SlotAggregator(DeviceHashAggregator):
             vals = [v[keep] for v in vals]
             m = len(keep)
         B = self.batch_cap
+        # int32 slot indices: halves the per-batch index transfer and keeps
+        # the scatter index math native on TPU (int64 is x64-emulated)
+        idx_dt = np.int32 if self.cap < _I32_MAX else np.int64
+        merge = self._merge_mode
         if m == B:
             # full-width chunk (steady state): no padding copies needed
-            slots = row_slots
-            vs = [np.asarray(v, dtype=dt) for v, dt in zip(vals, self.acc_dtypes)]
+            slots = row_slots.astype(idx_dt, copy=False)
+            vs = [np.asarray(v, dtype=dt)
+                  for v, k, dt in zip(vals, self.acc_kinds, self.acc_dtypes)
+                  if merge or k != "count"]
         else:
-            slots = np.full(B, self.cap, dtype=np.int64)  # pad -> dropped
+            slots = np.full(B, self.cap, dtype=idx_dt)  # pad -> dropped
             slots[:m] = row_slots
             vs = []
             for v, k, dt in zip(vals, self.acc_kinds, self.acc_dtypes):
+                if not merge and k == "count":
+                    continue
                 arr = np.full(B, _identity(k, dt), dtype=dt)
                 arr[:m] = v
                 vs.append(arr)
-        self.state = self._step(self.state, slots, tuple(vs))
+        step = self._step_merge if merge else self._step
+        self.state = step(self.state, slots, tuple(vs))
 
     def _spill_update(self, keys_i64, bins_i64, vals) -> None:
         order = np.lexsort((keys_i64, bins_i64))
@@ -569,6 +623,16 @@ class SlotAggregator(DeviceHashAggregator):
             d.boundary = below
 
     # ------------------------------------------------------------- state sync
+
+    def restore(self, key_u64, bins, accs) -> None:
+        if self.backend == "numpy":
+            return super().restore(key_u64, bins, accs)
+        self.state = self._init_jax_state()
+        self._merge_mode = True
+        try:
+            self.update(key_u64, bins.astype(np.int32), accs)
+        finally:
+            self._merge_mode = False
 
     def snapshot(self):
         if self.backend == "numpy":

@@ -104,7 +104,7 @@ def main() -> None:
     base_events = int(os.environ.get("ARROYO_BENCH_BASELINE_EVENTS", 500_000))
 
     # warm-up: compile the device step on small input
-    w_wall, _, _ = run_once("jax", 50_000, batch_size=32768)
+    w_wall, _, _ = run_once("jax", 50_000, batch_size=65536)
     print(f"# warmup (compile): {w_wall:.1f}s", file=sys.stderr)
 
     # the remote-device tunnel has +-25% run-to-run variance; report the
@@ -115,7 +115,9 @@ def main() -> None:
     eps = 0.0
     for r in range(reps):
         gc.collect()
-        wall, n, rows = run_once("jax", events, batch_size=32768)
+        # 65536 is the tunnel sweet spot after the count-lane/int32-slot byte
+        # cuts (measured sweep: 65536 best ~1.7M ev/s vs 32768 ~1.26M)
+        wall, n, rows = run_once("jax", events, batch_size=65536)
         expected_bids = int(n * 46 / 50)
         got_bids = sum(int(b["bids"].sum()) for b in rows)
         assert got_bids == expected_bids, f"parity failure: {got_bids} != {expected_bids}"

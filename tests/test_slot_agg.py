@@ -65,6 +65,35 @@ def test_snapshot_with_live_spill_entries():
     assert _table(k, b, accs) == got
 
 
+def test_restore_merges_partial_counts():
+    """Restore must scatter the snapshotted partial counts (merge mode), not
+    +1 per restored row: a checkpoint taken after several updates of the
+    same keys carries counts > 1, and a regression that routes restore
+    through the constant-increment hot step would floor them back to 1."""
+    agg = _mk()
+    keys = np.arange(8, dtype=np.uint64)
+    ones = np.ones(8, dtype=np.int64)
+    vals = np.arange(8, dtype=np.int64)
+    for _ in range(3):  # counts reach 3, sums reach 3*vals
+        agg.update(keys, np.zeros(8, dtype=np.int32), [ones, vals])
+    sk, sb, saccs = agg.snapshot()
+
+    fresh = _mk()
+    fresh.restore(sk, sb, saccs)
+    k, b, accs = fresh.extract(0, 1, 1)
+    assert _table(k, b, accs) == {
+        (i, 0): (3.0, float(3 * i)) for i in range(8)
+    }
+    # and post-restore updates keep counting from the restored partials
+    fresh2 = _mk()
+    fresh2.restore(sk, sb, saccs)
+    fresh2.update(keys, np.zeros(8, dtype=np.int32), [ones, vals])
+    k2, b2, accs2 = fresh2.extract(0, 1, 1)
+    assert _table(k2, b2, accs2) == {
+        (i, 0): (4.0, float(4 * i)) for i in range(8)
+    }
+
+
 def test_spill_restore_round_trip():
     """snapshot -> restore into a fresh aggregator -> identical output
     (restore itself may spill again; that must be transparent)."""

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Per-stage wall-clock profile of the q7 bench pipeline.
+
+Monkey-patches timing wrappers around the hot-path stages (source
+generation, value/key operators, slot-aggregate update, window close
+dispatch/fetch, emission) and runs bench.run_once. Nested keys overlap:
+agg_process_total includes agg_update_chunk, which includes dir_lookup.
+
+Usage:
+    python tools/profile_stages.py [events] [batch_size]
+    ARROYO_BENCH_PLATFORM=cpu python tools/profile_stages.py 200000
+
+Runs on the default platform (the real TPU chip under the driver tunnel)
+unless ARROYO_BENCH_PLATFORM overrides it. This is the methodology that
+found round 2's fetch-latency stall; keep it working as the bench evolves.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import arroyo_tpu
+from arroyo_tpu import config as cfg
+
+
+def main() -> None:
+    if os.environ.get("ARROYO_BENCH_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["ARROYO_BENCH_PLATFORM"])
+    import bench
+
+    events = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 32_768
+
+    arroyo_tpu._load_operators()
+    cfg.update({
+        "pipeline.source-batch-size": batch,
+        "pipeline.chaining.enabled": True,
+        "device.batch-capacity": batch,
+        "device.table-capacity": 65536,
+        "device.emit-capacity": 8192,
+        "checkpoint.storage-url": "/tmp/arroyo-tpu-bench/checkpoints",
+    })
+
+    T: dict[str, float] = {}
+    C: dict[str, int] = {}
+
+    def wrap(obj, name, key):
+        orig = getattr(obj, name)
+
+        def timed(*a, **k):
+            t0 = time.perf_counter()
+            r = orig(*a, **k)
+            T[key] = T.get(key, 0.0) + (time.perf_counter() - t0)
+            C[key] = C.get(key, 0) + 1
+            return r
+
+        setattr(obj, name, timed)
+
+    from arroyo_tpu.connectors import nexmark as nx
+    from arroyo_tpu.operators import builtin as bi
+    from arroyo_tpu.ops import slot_agg as sa
+    from arroyo_tpu.windows import tumbling as tw
+
+    wrap(nx.NexmarkSource, "_generate", "source_generate")
+    wrap(bi.ValueOperator, "process_batch", "value_op_total")
+    wrap(bi.KeyOperator, "process_batch", "key_op_total")
+    wrap(tw.TumblingAggregate, "process_batch", "agg_process_total")
+    wrap(sa.SlotAggregator, "_update_chunk", "agg_update_chunk")
+    wrap(sa.BinSlotDirectory, "lookup_or_assign", "dir_lookup")
+    wrap(sa.SlotAggregator, "extract_start", "close_dispatch")
+    wrap(sa.SlotExtractHandle, "result", "close_fetch_materialize")
+    wrap(tw.TumblingAggregate, "_emit_entries", "emit_entries")
+
+    bench.run_once("jax", 50_000, batch_size=batch)  # compile warmup
+    T.clear()
+    C.clear()
+    wall, n, _rows = bench.run_once("jax", events, batch_size=batch)
+    print(f"\n{n} events in {wall:.2f}s = {n / wall:,.0f} ev/s")
+    for k, v in sorted(T.items(), key=lambda kv: -kv[1]):
+        print(f"  {k:26s} {v * 1000:8.1f} ms   x{C[k]}")
+
+
+if __name__ == "__main__":
+    main()
