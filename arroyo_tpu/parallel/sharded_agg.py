@@ -11,18 +11,29 @@ arroyo-worker/src/network_manager.rs) with an in-program exchange over ICI:
        arroyo-types/src/lib.rs:621 server_for_hash, so host and device
        agree on ownership)
     3. bucket partials into a fixed [n_dev, per_dest_cap] send buffer
-       (sort by owner + rank-in-owner scatter, drop+count overflow)
+       (sort by owner + rank-in-owner scatter); partials past a
+       destination's cap are NOT dropped — they stay resident on the
+       producing shard (skew tolerance: window close combines across
+       shards on host, so non-owner residency is harmless)
     4. jax.lax.all_to_all over the mesh axis  <- the ICI shuffle
-    5. sort_reduce the received rows (combining duplicates of the same
-       (bin, key) arriving from different shards)
-    6. probe_merge into this device's HBM hash-table shard
+    5. sort_reduce the received rows + the kept-local overflow together
+    6. probe_merge into this device's HBM hash-table shard; rows the table
+       cannot place (probe exhaustion / table pressure) append into a
+       per-shard HBM spill buffer instead of erroring — the sharded
+       mirror of the single-chip host-spill tier (SURVEY §7 hard-part 1)
 
   The whole thing is ONE jitted XLA program per step: hashing, partials,
   exchange, and state update all fuse; XLA schedules the all_to_all on ICI.
+  The overflow counter trips only when even the spill buffer is full.
 
 State layout: every table array gains a leading mesh dimension
 [n_dev, cap] sharded on the "data" axis; extraction (window close) is a
-per-shard compaction producing [n_dev, emit_cap] outputs.
+per-shard compaction producing [n_dev, emit_cap] outputs, combined with the
+spill rows on host.
+
+The host-facing surface (update / extract / extract_start / scan_range /
+free_bins_below / snapshot / restore) matches SlotAggregator so window
+operators construct either interchangeably (windows/tumbling.py mesh mode).
 """
 
 from __future__ import annotations
@@ -31,7 +42,13 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from ..ops.aggregate import _identity, drain_extract, probe_merge, sort_reduce
+from ..ops.aggregate import (
+    _identity,
+    combine_by_key_bin,
+    drain_extract,
+    probe_merge,
+    sort_reduce,
+)
 from .mesh import KEY_AXIS
 
 _U64_MAX = (1 << 64) - 1
@@ -47,13 +64,31 @@ def _shard_map(fn, mesh, in_specs, out_specs):
     return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 
+class _ReadyHandle:
+    """Synchronous stand-in for SlotExtractHandle: the sharded close gathers
+    on the spot (the all_to_all path has no per-region async transport yet),
+    so the pipelined emission path sees an always-ready handle."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def is_ready(self) -> bool:
+        return True
+
+    def result(self):
+        return self._value
+
+
 class ShardedAggregator:
     """Key-space-sharded (bin, key) -> accumulators store over a mesh.
 
     update_sharded: [n_dev, B]-shaped per-device batches -> one fused step
     (local partials + all_to_all + merge). extract_all: per-shard compaction
-    of closed bins, gathered to host.
+    of closed bins, gathered to host. update/extract/snapshot/restore: the
+    host-row surface shared with SlotAggregator.
     """
+
+    backend = "jax"
 
     def __init__(
         self,
@@ -65,6 +100,7 @@ class ShardedAggregator:
         per_dest_cap: Optional[int] = None,
         max_probes: int = 64,
         emit_cap: int = 8192,
+        spill_cap: int = 2048,
     ):
         import jax
         import jax.numpy as jnp
@@ -81,29 +117,38 @@ class ShardedAggregator:
         self.per_dest_cap = per_dest_cap or max(batch_cap // max(self.n_dev // 2, 1), 64)
         self.max_probes = max_probes
         self.emit_cap = emit_cap
+        self.spill_cap = spill_cap
 
         n_dev = self.n_dev
         dest_cap = self.per_dest_cap
         acc_kinds_t = self.acc_kinds
         acc_dtypes_t = self.acc_dtypes
         recv_cap = n_dev * dest_cap
+        spill_cap_ = spill_cap
 
         def unpack(state):
-            keys_t, bins_t, occ_t, accs_t, oflow_t = state
+            (keys_t, bins_t, occ_t, accs_t, oflow_t,
+             sp_key, sp_bin, sp_fill, sp_accs) = state
             return (
                 keys_t[0], bins_t[0], occ_t[0],
                 tuple(a[0] for a in accs_t), oflow_t[0],
+                sp_key[0], sp_bin[0], sp_fill[0],
+                tuple(a[0] for a in sp_accs),
             )
 
-        def pack(keys_t, bins_t, occ_t, accs_t, oflow_t):
+        def pack(keys_t, bins_t, occ_t, accs_t, oflow_t,
+                 sp_key, sp_bin, sp_fill, sp_accs):
             return (
                 keys_t[None], bins_t[None], occ_t[None],
                 tuple(a[None] for a in accs_t), oflow_t[None],
+                sp_key[None], sp_bin[None], sp_fill[None],
+                tuple(a[None] for a in sp_accs),
             )
 
         def local_step(state, key, bins, valid, vals):
             """Per-device body under shard_map (leading mesh dim is 1)."""
-            keys_t, bins_t, occ_t, accs_t, oflow_t = unpack(state)
+            (keys_t, bins_t, occ_t, accs_t, oflow_t,
+             sp_key, sp_bin, sp_fill, sp_accs) = unpack(state)
             key, bins, valid = key[0], bins[0], valid[0]
             vals = tuple(v[0] for v in vals)
             # --- 1. local pre-aggregation
@@ -129,8 +174,11 @@ class ShardedAggregator:
                 jnp.clip(o_s, 0, n_dev - 1)
             ]
             sendable = (o_s < n_dev) & (rank < dest_cap)
+            # skew: partials past the destination cap stay LOCAL (merged into
+            # this shard's table below); close-time host combine makes
+            # non-owner residency correct, so hot keys degrade, not crash
+            keep_local = (o_s < n_dev) & (rank >= dest_cap)
             slot = jnp.where(sendable, o_s * dest_cap + rank, recv_cap)
-            dropped = jnp.sum((o_s < n_dev) & (rank >= dest_cap), dtype=jnp.int32)
 
             def scatter(src, fill):
                 buf = jnp.full((recv_cap,), fill, dtype=src.dtype)
@@ -157,29 +205,56 @@ class ShardedAggregator:
             r_bin = a2a(s_bin)
             r_valid = a2a(s_valid)
             r_accs = tuple(a2a(a) for a in s_accs)
-            # --- 5. combine duplicates across source shards
+            # --- 5. combine received rows + kept-local overflow together
+            m_key = jnp.concatenate([r_key, u_key[order]])
+            m_bin = jnp.concatenate([r_bin, u_bin[order]])
+            m_valid = jnp.concatenate([r_valid, keep_local])
+            m_accs = tuple(
+                jnp.concatenate([r_accs[i], u_accs[i][order]])
+                for i in range(len(acc_kinds_t))
+            )
             c_key, c_bin, c_active, c_accs = sort_reduce(
-                acc_kinds_t, r_key, r_bin, r_valid, r_accs, recv_cap
+                acc_kinds_t, m_key, m_bin, m_valid, m_accs, recv_cap + batch_cap
             )
             # --- 6. merge into the local table shard
             (keys_t, bins_t, occ_t, accs_t), still_active = probe_merge(
                 acc_kinds_t, (keys_t, bins_t, occ_t, accs_t),
                 c_key, c_bin, c_active, c_accs, cap, max_probes,
             )
-            oflow_t = oflow_t + jnp.sum(still_active, dtype=jnp.int32) + dropped
-            return pack(keys_t, bins_t, occ_t, accs_t, oflow_t)
+            # --- 7. table-pressure spill: unplaced rows append into the
+            # per-shard HBM spill buffer; only spill-buffer exhaustion counts
+            # as overflow
+            sidx = sp_fill + jnp.cumsum(still_active.astype(jnp.int32)) - 1
+            ok = still_active & (sidx < spill_cap_)
+            pos = jnp.where(ok, sidx, spill_cap_)
+            sp_key = sp_key.at[pos].set(c_key, mode="drop")
+            sp_bin = sp_bin.at[pos].set(c_bin, mode="drop")
+            sp_accs = tuple(
+                sp_accs[i].at[pos].set(c_accs[i], mode="drop")
+                for i in range(len(acc_kinds_t))
+            )
+            n_spilled = jnp.sum(ok, dtype=jnp.int32)
+            n_lost = jnp.sum(still_active, dtype=jnp.int32) - n_spilled
+            sp_fill = jnp.minimum(sp_fill + n_spilled, spill_cap_)
+            oflow_t = oflow_t + n_lost
+            return pack(keys_t, bins_t, occ_t, accs_t, oflow_t,
+                        sp_key, sp_bin, sp_fill, sp_accs)
 
-        spec_state = (
-            PS(KEY_AXIS, None), PS(KEY_AXIS, None), PS(KEY_AXIS, None),
-            tuple(PS(KEY_AXIS, None) for _ in self.acc_kinds), PS(KEY_AXIS),
-        )
+        def spec_state():
+            return (
+                PS(KEY_AXIS, None), PS(KEY_AXIS, None), PS(KEY_AXIS, None),
+                tuple(PS(KEY_AXIS, None) for _ in self.acc_kinds), PS(KEY_AXIS),
+                PS(KEY_AXIS, None), PS(KEY_AXIS, None), PS(KEY_AXIS),
+                tuple(PS(KEY_AXIS, None) for _ in self.acc_kinds),
+            )
+
         spec_batch = PS(KEY_AXIS, None)
         self._step = jax.jit(
             _shard_map(
                 local_step, mesh,
-                in_specs=(spec_state, spec_batch, spec_batch, spec_batch,
+                in_specs=(spec_state(), spec_batch, spec_batch, spec_batch,
                           tuple(spec_batch for _ in self.acc_kinds)),
-                out_specs=spec_state,
+                out_specs=spec_state(),
             ),
             donate_argnums=0,
         )
@@ -187,7 +262,8 @@ class ShardedAggregator:
         emit_cap_ = self.emit_cap
 
         def local_extract(state, emit_lo, emit_hi, free_below):
-            keys_t, bins_t, occ_t, accs_t, oflow_t = unpack(state)
+            (keys_t, bins_t, occ_t, accs_t, oflow_t,
+             sp_key, sp_bin, sp_fill, sp_accs) = unpack(state)
             emit_mask = occ_t & (bins_t >= emit_lo) & (bins_t < emit_hi)
             total = jnp.sum(emit_mask, dtype=jnp.int32)
             order = jnp.argsort(~emit_mask)
@@ -201,7 +277,8 @@ class ShardedAggregator:
             occ_t = occ_t & ~free_mask
             occ_t = occ_t.at[jnp.where(emitted_free, sel, cap)].set(False, mode="drop")
             return (
-                pack(keys_t, bins_t, occ_t, accs_t, oflow_t),
+                pack(keys_t, bins_t, occ_t, accs_t, oflow_t,
+                     sp_key, sp_bin, sp_fill, sp_accs),
                 (out_key[None], out_bin[None], out_valid[None],
                  tuple(a[None] for a in out_accs), total[None]),
             )
@@ -213,8 +290,8 @@ class ShardedAggregator:
         self._extract = jax.jit(
             _shard_map(
                 local_extract, mesh,
-                in_specs=(spec_state, PS(), PS(), PS()),
-                out_specs=(spec_state, spec_out),
+                in_specs=(spec_state(), PS(), PS(), PS()),
+                out_specs=(spec_state(), spec_out),
             ),
             donate_argnums=0,
         )
@@ -227,7 +304,7 @@ class ShardedAggregator:
 
         shard = NamedSharding(self.mesh, PS(KEY_AXIS, None))
         shard1 = NamedSharding(self.mesh, PS(KEY_AXIS))
-        n, cap = self.n_dev, self.cap
+        n, cap, sc = self.n_dev, self.cap, self.spill_cap
         return (
             jax.device_put(jnp.zeros((n, cap), dtype=jnp.int64), shard),
             jax.device_put(jnp.zeros((n, cap), dtype=jnp.int32), shard),
@@ -237,19 +314,75 @@ class ShardedAggregator:
                 for k, d in zip(self.acc_kinds, self.acc_dtypes)
             ),
             jax.device_put(jnp.zeros((n,), dtype=jnp.int32), shard1),
+            jax.device_put(jnp.zeros((n, sc), dtype=jnp.int64), shard),
+            jax.device_put(jnp.zeros((n, sc), dtype=jnp.int32), shard),
+            jax.device_put(jnp.zeros((n,), dtype=jnp.int32), shard1),
+            tuple(
+                jax.device_put(jnp.full((n, sc), _identity(k, d), dtype=d), shard)
+                for k, d in zip(self.acc_kinds, self.acc_dtypes)
+            ),
         )
 
-    # ------------------------------------------------------------------
+    # ------------------------------------------------------- sharded surface
 
     def update_sharded(self, key_i64, bins, valid, vals) -> None:
         """key_i64/bins/valid: [n_dev, batch_cap] (device-local rows);
         vals: one [n_dev, batch_cap] array per accumulator."""
         self.state = self._step(self.state, key_i64, bins, valid, tuple(vals))
 
+    def _drain_spill(self, emit_lo: int, emit_hi: int, free_below: int):
+        """Host-side spill-buffer drain: gather the (small) per-shard spill
+        arrays, emit rows in range, drop rows below free_below, write the
+        compacted remainder back (sharded)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        (keys_t, bins_t, occ_t, accs_t, oflow_t,
+         sp_key, sp_bin, sp_fill, sp_accs) = self.state
+        fill = np.asarray(sp_fill)
+        if int(fill.sum()) == 0:
+            return (np.empty(0, dtype=np.uint64), np.empty(0, dtype=np.int32),
+                    [np.empty(0, dtype=d) for d in self.acc_dtypes])
+        k = np.asarray(sp_key)
+        b = np.asarray(sp_bin)
+        accs = [np.asarray(a) for a in sp_accs]
+        n, sc = self.n_dev, self.spill_cap
+        in_fill = np.arange(sc)[None, :] < fill[:, None]
+        emit = in_fill & (b >= emit_lo) & (b < emit_hi)
+        keep = in_fill & ~(b < free_below)
+        out = (k[emit].view(np.uint64), b[emit].astype(np.int32),
+               [a[emit] for a in accs])
+        # compact kept rows per shard and write back
+        new_k = np.zeros((n, sc), dtype=np.int64)
+        new_b = np.zeros((n, sc), dtype=np.int32)
+        new_accs = [np.full((n, sc), _identity(kk, d), dtype=d)
+                    for kk, d in zip(self.acc_kinds, self.acc_dtypes)]
+        new_fill = np.zeros(n, dtype=np.int32)
+        for d_i in range(n):
+            sel = np.flatnonzero(keep[d_i])
+            m = len(sel)
+            new_fill[d_i] = m
+            new_k[d_i, :m] = k[d_i, sel]
+            new_b[d_i, :m] = b[d_i, sel]
+            for j in range(len(accs)):
+                new_accs[j][d_i, :m] = accs[j][d_i, sel]
+        shard = NamedSharding(self.mesh, PS(KEY_AXIS, None))
+        shard1 = NamedSharding(self.mesh, PS(KEY_AXIS))
+        self.state = (
+            keys_t, bins_t, occ_t, accs_t, oflow_t,
+            jax.device_put(new_k, shard),
+            jax.device_put(new_b, shard),
+            jax.device_put(new_fill, shard1),
+            tuple(jax.device_put(a, shard) for a in new_accs),
+        )
+        return out
+
     def extract_all(self, emit_lo: int, emit_hi: int, free_below: int):
         """Close bins across all shards; returns host (key_u64, bin, accs).
         Drains per emit_cap chunk until every shard is empty; shard outputs
-        are [n_dev, emit_cap] and flattened before the shared drain logic."""
+        are [n_dev, emit_cap] and flattened before the shared drain logic.
+        Spill-buffer rows for the range are combined in on host."""
 
         def extract_once():
             self.state, (k, b, v, accs, total) = self._extract(
@@ -265,10 +398,98 @@ class ShardedAggregator:
 
         out = drain_extract(extract_once, self.emit_cap, self.acc_kinds,
                             self.acc_dtypes, emit_lo, free_below)
+        sk, sb, saccs = self._drain_spill(emit_lo, emit_hi, free_below)
+        if len(sk):
+            out = combine_by_key_bin(
+                self.acc_kinds,
+                np.concatenate([out[0], sk]),
+                np.concatenate([out[1], sb]),
+                [np.concatenate([a, s]) for a, s in zip(out[2], saccs)],
+            )
         overflow = int(np.asarray(self.state[4]).sum())
         if overflow > 0:
             raise RuntimeError(
-                f"sharded aggregate overflow ({overflow} entries dropped) — raise "
-                f"table capacity or per_dest_cap"
+                f"sharded aggregate overflow ({overflow} entries lost: table and "
+                f"spill buffer both full) — raise table capacity or spill_cap"
             )
         return out
+
+    # ---------------------------------------------------- SlotAggregator API
+
+    def _distribute(self, key_i64, bins, vals):
+        """Round-robin host rows into [n_dev, batch_cap] chunks (initial
+        placement is arbitrary — the in-program all_to_all re-routes by key
+        ownership, like the reference's source->shuffle edge)."""
+        n = len(key_i64)
+        n_dev, B = self.n_dev, self.batch_cap
+        per_step = n_dev * B
+        for lo in range(0, n, per_step):
+            hi = min(lo + per_step, n)
+            m = hi - lo
+            k = np.zeros((n_dev, B), dtype=np.int64)
+            b = np.zeros((n_dev, B), dtype=np.int32)
+            valid = np.zeros((n_dev, B), dtype=bool)
+            vs = [np.full((n_dev, B), _identity(kk, d), dtype=d)
+                  for kk, d in zip(self.acc_kinds, self.acc_dtypes)]
+            rows = np.arange(lo, hi)
+            dev = (rows - lo) % n_dev
+            pos = (rows - lo) // n_dev
+            k[dev, pos] = key_i64[lo:hi]
+            b[dev, pos] = bins[lo:hi]
+            valid[dev, pos] = True
+            for j, v in enumerate(vals):
+                vs[j][dev, pos] = v[lo:hi]
+            yield k, b, valid, vs
+
+    def update(self, key_u64, bins, vals) -> None:
+        key_i64 = np.ascontiguousarray(key_u64, dtype=np.uint64).view(np.int64)
+        bins = np.asarray(bins, dtype=np.int32)
+        vals = [np.asarray(v, dtype=d) for v, d in zip(vals, self.acc_dtypes)]
+        for k, b, valid, vs in self._distribute(key_i64, bins, vals):
+            self.update_sharded(k, b, valid, vs)
+
+    def extract(self, emit_lo: int, emit_hi: int, free_below: int):
+        return self.extract_all(emit_lo, emit_hi, free_below)
+
+    def extract_start(self, emit_lo: int, emit_hi: int, free_below: int):
+        return _ReadyHandle(self.extract_all(emit_lo, emit_hi, free_below))
+
+    def free_bins_below(self, below: int) -> None:
+        # empty emit range: frees every table + spill row with bin < below
+        self.extract_all(below, below, below)
+
+    def scan_range(self, emit_lo: int, emit_hi: int):
+        k, b, accs = self.snapshot()
+        sel = (b >= emit_lo) & (b < emit_hi)
+        return k[sel], b[sel], [a[sel] for a in accs]
+
+    def snapshot(self):
+        """Exact non-destructive state readout: gather the sharded table +
+        spill buffers and combine on host (checkpoint path; off the hot
+        loop, so a full [n_dev, cap] gather is acceptable)."""
+        (keys_t, bins_t, occ_t, accs_t, _oflow_t,
+         sp_key, sp_bin, sp_fill, sp_accs) = self.state
+        occ = np.asarray(occ_t)
+        keys = np.asarray(keys_t)[occ].view(np.uint64)
+        bins = np.asarray(bins_t)[occ].astype(np.int32)
+        accs = [np.asarray(a)[occ] for a in accs_t]
+        fill = np.asarray(sp_fill)
+        if int(fill.sum()):
+            in_fill = np.arange(self.spill_cap)[None, :] < fill[:, None]
+            keys = np.concatenate([keys, np.asarray(sp_key)[in_fill].view(np.uint64)])
+            bins = np.concatenate([bins, np.asarray(sp_bin)[in_fill].astype(np.int32)])
+            accs = [np.concatenate([a, np.asarray(s)[in_fill]])
+                    for a, s in zip(accs, sp_accs)]
+        if not len(keys):
+            return (np.empty(0, dtype=np.uint64), np.empty(0, dtype=np.int32),
+                    [np.empty(0, dtype=d) for d in self.acc_dtypes])
+        return combine_by_key_bin(self.acc_kinds, keys, bins, accs)
+
+    def restore(self, key_u64, bins, accs) -> None:
+        """Merge snapshotted partials back in: the sharded kernel combines
+        count like sum (partials arrive as values), so update() is the
+        correct merge path — unlike SlotAggregator's constant-increment hot
+        step, no separate merge mode is needed."""
+        self.state = self._init_state()
+        self.update(np.asarray(key_u64, dtype=np.uint64),
+                    np.asarray(bins, dtype=np.int32), accs)

@@ -179,18 +179,37 @@ class TumblingAggregate(Operator):
 
     def _aggregator(self):
         if self._agg is None:
-            from ..ops.slot_agg import SlotAggregator
-
             dev = config().section("device")
-            self._agg = SlotAggregator(
-                self.acc_kinds,
-                self.acc_dtypes,
-                cap=dev.get("table-capacity", 65536),
-                batch_cap=dev.get("batch-capacity", 8192),
-                emit_cap=dev.get("emit-capacity", 8192),
-                backend=self.backend,
-                region_size=dev.get("region-size", 2048),
-            )
+            mesh_n = int(dev.get("mesh-devices", 0) or 0)
+            if self.backend == "jax" and mesh_n > 1:
+                # mesh execution mode: key-space-sharded state over an
+                # n-device mesh, keyed exchange = in-program all_to_all over
+                # ICI (replaces the reference's repartition shuffle,
+                # crates/arroyo-operator/src/context.rs:502-556)
+                from ..parallel import ShardedAggregator, make_mesh
+
+                self._agg = ShardedAggregator(
+                    make_mesh(mesh_n),
+                    self.acc_kinds,
+                    self.acc_dtypes,
+                    cap=dev.get("table-capacity", 65536),
+                    batch_cap=dev.get("batch-capacity", 8192),
+                    max_probes=dev.get("max-probes", 64),
+                    emit_cap=dev.get("emit-capacity", 8192),
+                    spill_cap=dev.get("spill-capacity", 2048),
+                )
+            else:
+                from ..ops.slot_agg import SlotAggregator
+
+                self._agg = SlotAggregator(
+                    self.acc_kinds,
+                    self.acc_dtypes,
+                    cap=dev.get("table-capacity", 65536),
+                    batch_cap=dev.get("batch-capacity", 8192),
+                    emit_cap=dev.get("emit-capacity", 8192),
+                    backend=self.backend,
+                    region_size=dev.get("region-size", 2048),
+                )
         return self._agg
 
     def on_start(self, ctx):

@@ -1,0 +1,146 @@
+"""Mesh execution mode: TumblingAggregate over an 8-virtual-device CPU mesh.
+
+The operator constructs a ShardedAggregator (keyed all_to_all exchange over
+the mesh axis) instead of the single-chip SlotAggregator when
+device.mesh-devices > 1 — the engine-integrated form of the multi-chip path
+(VERDICT r3 item 2). Covers: end-to-end parity with the host oracle,
+checkpoint/restore through the sharded state, and skew (one hot key)
+degrading to local residency + spill instead of erroring.
+"""
+
+import numpy as np
+import pytest
+
+from arroyo_tpu.engine import Engine, run_graph
+from arroyo_tpu.hashing import hash_column
+
+from test_tumbling import expected_counts, windowed_count_graph
+
+
+def _mesh_devices():
+    import jax
+
+    return len(jax.devices())
+
+
+@pytest.fixture
+def _mesh_cfg(_storage):
+    from arroyo_tpu import config as cfg
+
+    if _mesh_devices() < 8:
+        pytest.skip("needs 8 virtual devices (conftest sets XLA_FLAGS)")
+    cfg.update({"device.mesh-devices": 8, "device.table-capacity": 1024,
+                "device.batch-capacity": 256, "device.emit-capacity": 256,
+                "device.spill-capacity": 256, "device.max-probes": 32})
+    yield
+    cfg.update({"device.mesh-devices": 0})
+
+
+def test_mesh_tumbling_end_to_end_parity(_mesh_cfg):
+    """Full pipeline through the engine with the sharded aggregator: output
+    must equal the closed-form expectation (same as the single-chip runs)."""
+    rows: list = []
+    g = windowed_count_graph(rows, backend="jax", count=3000)
+    run_graph(g, job_id="mesh-tw", timeout=120)
+    got = {(r["window_start"] // 1_000_000, r["k"]): (r["cnt"], r["total"])
+           for r in rows}
+    assert got == expected_counts(count=3000)
+
+
+def test_mesh_tumbling_checkpoint_restore(_mesh_cfg):
+    """Checkpoint mid-stream, stop, restore into a fresh engine (sharded
+    snapshot -> table -> sharded restore): merged output is exact."""
+    rows2: list = []
+    g2 = windowed_count_graph(rows2, backend="jax", count=4000)
+    g2.nodes["src"].config["event_rate"] = 2000
+    eng = Engine(g2, job_id="mesh-ckpt")
+    eng.start()
+    assert eng.checkpoint_and_wait(1, timeout=60)
+    eng.stop()
+    eng.join(timeout=60)
+
+    rows3: list = []
+    g3 = windowed_count_graph(rows3, backend="jax", count=4000)
+    eng3 = Engine(g3, job_id="mesh-ckpt", restore_epoch=1)
+    eng3.run_to_completion(timeout=120)
+    merged = {}
+    for r in rows2 + rows3:
+        merged[(r["window_start"] // 1_000_000, r["k"])] = (r["cnt"], r["total"])
+    assert merged == expected_counts(count=4000)
+
+
+@pytest.mark.parametrize("name", ["tumbling_aggregates", "grouped_aggregates"])
+def test_mesh_smoke_query_golden(name, _mesh_cfg, tmp_path):
+    """A real SQL smoke query through the sharded path: plan -> engine with
+    device.mesh-devices=8 -> output equals the golden file (the 'one smoke
+    query produces correct output through the sharded path' gate)."""
+    from test_smoke import assert_outputs, build, load_sql
+
+    out = str(tmp_path / "out.json")
+    eng = build(load_sql(name, out), 1, f"mesh-smoke-{name}")
+    eng.run_to_completion(timeout=180)
+    assert_outputs(name, out)
+
+
+def test_mesh_skewed_hot_key_differential():
+    """One hot key receiving most rows on 8 devices: per-destination send
+    caps overflow, so partials stay resident on producing shards and the
+    close-time host combine reconciles them — exact results, no error
+    (VERDICT r3 item 6; previously fatal at parallel/sharded_agg.py:269)."""
+    from arroyo_tpu.ops import DeviceHashAggregator
+    from arroyo_tpu.parallel import ShardedAggregator, make_mesh
+
+    if _mesh_devices() < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = make_mesh(8)
+    agg = ShardedAggregator(mesh, ("sum", "count"), (np.int64, np.int64),
+                            cap=512, batch_cap=64, per_dest_cap=4,
+                            max_probes=16, emit_cap=128, spill_cap=64)
+    ora = DeviceHashAggregator(("sum", "count"), (np.int64, np.int64),
+                               backend="numpy")
+    rng = np.random.default_rng(3)
+    for _ in range(6):
+        n = 8 * 64
+        raw = np.where(rng.random(n) < 0.9, 17, rng.integers(0, 40, size=n))
+        keys = hash_column(raw.astype(np.int64))
+        bins = rng.integers(0, 2, size=n).astype(np.int32)
+        vals = rng.integers(1, 50, size=n).astype(np.int64)
+        ones = np.ones(n, dtype=np.int64)
+        agg.update(keys, bins, [vals, ones])
+        ora.update(keys, bins, [vals, ones])
+    sk, sb, sa = agg.extract_all(0, 10, 10)
+    ok, ob, oa = ora.extract(0, 10, 10)
+    to_dict = lambda K, B, A: {
+        (int(b_), int(k_)): (int(A[0][i]), int(A[1][i]))
+        for i, (k_, b_) in enumerate(zip(K.view(np.int64), B))
+    }
+    assert to_dict(sk, sb, sa) == to_dict(ok, ob, oa)
+
+
+def test_mesh_table_pressure_spills_not_fatal():
+    """More distinct groups than the probe table can absorb: the per-shard
+    HBM spill buffer catches the remainder and extraction is exact."""
+    from arroyo_tpu.ops import DeviceHashAggregator
+    from arroyo_tpu.parallel import ShardedAggregator, make_mesh
+
+    if _mesh_devices() < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = make_mesh(4)
+    # tiny table + tiny probe budget force placement failures
+    agg = ShardedAggregator(mesh, ("count",), (np.int64,),
+                            cap=64, batch_cap=128, per_dest_cap=128,
+                            max_probes=2, emit_cap=64, spill_cap=512)
+    ora = DeviceHashAggregator(("count",), (np.int64,), backend="numpy")
+    rng = np.random.default_rng(5)
+    for _ in range(3):
+        n = 4 * 128
+        keys = hash_column(rng.integers(0, 400, size=n).astype(np.int64))
+        bins = np.zeros(n, dtype=np.int32)
+        ones = np.ones(n, dtype=np.int64)
+        agg.update(keys, bins, [ones])
+        ora.update(keys, bins, [ones])
+    sk, sb, sa = agg.extract_all(0, 10, 10)
+    ok, ob, oa = ora.extract(0, 10, 10)
+    got = {int(k_): int(sa[0][i]) for i, k_ in enumerate(sk.view(np.int64))}
+    want = {int(k_): int(oa[0][i]) for i, k_ in enumerate(ok.view(np.int64))}
+    assert got == want
