@@ -18,6 +18,9 @@ class VecSink(Operator):
         self.rows: list = cfg["rows"]
         self.include_internal = cfg.get("include_internal", False)
         self.columnar = cfg.get("columnar", False)
+        # optional shared list: wall_monotonic per appended batch (columnar
+        # mode) — the arrival half of the watermark-to-emit latency metric
+        self.arrival_walls: list | None = cfg.get("arrival_walls")
         self._lock = cfg.setdefault("_lock", threading.Lock())
 
     def process_batch(self, batch, ctx, collector, input_index=0):
@@ -29,6 +32,10 @@ class VecSink(Operator):
         with self._lock:
             if self.columnar:
                 self.rows.append(out)
+                if self.arrival_walls is not None:
+                    import time
+
+                    self.arrival_walls.append(time.monotonic())
             else:
                 self.rows.extend(out.to_pylist())
 

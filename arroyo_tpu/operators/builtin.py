@@ -88,6 +88,10 @@ class WatermarkGenerator(Operator):
         self.expr: Expr = cfg["expr"]
         self.interval_micros: int = cfg.get("interval_micros", 0)
         self.idle_time_micros: Optional[int] = cfg.get("idle_time_micros")
+        # optional shared list: (watermark_value, wall_monotonic) appended at
+        # each emission — the injection half of the watermark-to-emit
+        # latency metric (BASELINE.md; the sink records the arrival half)
+        self.latency_log: Optional[list] = cfg.get("latency_log")
         self.max_watermark: Optional[int] = None
         self.last_emitted: Optional[int] = None
         self.last_event_wall: float = time.monotonic()
@@ -128,6 +132,8 @@ class WatermarkGenerator(Operator):
                 self.last_emitted = m
                 from ..types import Signal
 
+                if self.latency_log is not None:
+                    self.latency_log.append((m, time.monotonic()))
                 collector.broadcast(Signal.watermark_of(Watermark.event_time(m)))
 
     def handle_checkpoint(self, barrier, ctx, collector):

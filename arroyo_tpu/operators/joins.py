@@ -19,6 +19,7 @@
 from __future__ import annotations
 
 import time as _time
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -28,6 +29,7 @@ from ..engine.engine import register_operator
 from ..expr import eval_expr
 from ..graph import OpName
 from ..operators.base import Operator, TableSpec
+from ..types import Signal
 from .updating_aggregate import IS_RETRACT_FIELD
 
 
@@ -62,16 +64,33 @@ def _hash_join_indices(
 
 class InstantJoin(Operator):
     """config: join_type: inner|left|right|full, left_names/right_names:
-    [(out_name, src_name)] column selections per side."""
+    [(out_name, src_name)] column selections per side, backend override
+    "jax"|"numpy"|None (default: device when enabled).
+
+    Device lowering: the sort/search phase of each window's join runs on
+    the device (ops/join_probe.py) and its result streams back while later
+    batches keep flowing — closes queue in order and each watermark is
+    forwarded only after its windows' rows, the same pipelining discipline
+    as the window aggregates."""
 
     def __init__(self, cfg: dict):
+        from ..config import config
+
         self.join_type: str = cfg.get("join_type", "inner")
         self.left_names: list[tuple[str, str]] = list(cfg["left_names"])
         self.right_names: list[tuple[str, str]] = list(cfg["right_names"])
+        self.backend = cfg.get("backend") or (
+            "jax" if config().get("device.enabled") else "numpy"
+        )
+        # below this many rows on either side, the numpy join is cheaper
+        # than a device dispatch
+        self.device_min_rows = int(config().get("device.join-min-rows", 2048))
         # t -> [left batches], [right batches]
         self.buf: dict[int, tuple[list, list]] = {}
         self.late_rows = 0
         self.emitted_before: Optional[int] = None
+        # in-flight closes: (JoinHandle|None, t, lb, rb, Watermark|None)
+        self._pending: deque = deque()
 
     def tables(self):
         return [
@@ -103,6 +122,8 @@ class InstantJoin(Operator):
                 ent[side].append(batch.filter(ts == t))
 
     def process_batch(self, batch, ctx, collector, input_index=0):
+        if self._pending:
+            self._drain_pending(collector)
         side = ctx.edge_of_input(input_index)
         if self.emitted_before is not None:
             late = batch.timestamps < self.emitted_before
@@ -114,28 +135,70 @@ class InstantJoin(Operator):
         self._buffer(batch, side)
 
     def handle_watermark(self, watermark, ctx, collector):
-        if not watermark.is_idle:
-            self._emit_closed(watermark.value, collector)
+        if watermark.is_idle:
+            self._drain_pending(collector, force=True)
+            return watermark
+        scheduled = self._schedule_closed(watermark.value, watermark, collector)
+        self._drain_pending(collector)
+        if scheduled or self._pending:
+            return None  # watermark rides the pending queue, in order
         return watermark
 
     def on_close(self, ctx, collector):
-        self._emit_closed(None, collector)
+        self._schedule_closed(None, None, collector)
+        self._drain_pending(collector, force=True)
 
-    def _emit_closed(self, before: Optional[int], collector) -> None:
-        ts_list = sorted(
-            t for t in self.buf if before is None or t < before
-        )
+    def _schedule_closed(self, before: Optional[int], wm, collector) -> bool:
+        """Queue the join for every window closed by the watermark; the
+        watermark marker is appended after its windows so emission order is
+        preserved. Returns True when anything was queued."""
+        ts_list = sorted(t for t in self.buf if before is None or t < before)
         for t in ts_list:
             left, right = self.buf.pop(t)
-            self._join_and_emit(t, left, right, collector)
+            while len(self._pending) >= 16:  # bound in-flight joins
+                handle, pt, lb, rb, pwm = self._pending.popleft()
+                if pwm is not None:
+                    collector.broadcast(Signal.watermark_of(pwm))
+                else:
+                    self._join_and_emit(pt, lb, rb, handle, collector)
+            self._pending.append(self._start_join(t, left, right))
         if before is not None and (
             self.emitted_before is None or before > self.emitted_before
         ):
             self.emitted_before = before
+        if wm is not None:
+            if self._pending or ts_list:
+                self._pending.append((None, None, None, None, wm))
+                return True
+            return False
+        return bool(ts_list)
 
-    def _join_and_emit(self, t: int, left: list, right: list, collector) -> None:
+    def _start_join(self, t: int, left: list, right: list):
         lb = Batch.concat(left) if left else None
         rb = Batch.concat(right) if right else None
+        handle = None
+        if lb is not None and rb is not None:
+            n = max(lb.num_rows, rb.num_rows)
+            if self.backend == "jax" and n >= self.device_min_rows:
+                from ..ops.join_probe import device_join_start
+
+                lk = lb.keys.astype(np.uint64).view(np.int64)
+                rk = rb.keys.astype(np.uint64).view(np.int64)
+                handle = device_join_start(lk, rk)
+        return (handle, t, lb, rb, None)
+
+    def _drain_pending(self, collector, force: bool = False) -> None:
+        while self._pending:
+            handle, t, lb, rb, wm = self._pending[0]
+            if wm is None and handle is not None and not force and not handle.is_ready():
+                return
+            self._pending.popleft()
+            if wm is not None:
+                collector.broadcast(Signal.watermark_of(wm))
+                continue
+            self._join_and_emit(t, lb, rb, handle, collector)
+
+    def _join_and_emit(self, t: int, lb, rb, handle, collector) -> None:
         jt = self.join_type
         if lb is None and rb is None:
             return
@@ -147,19 +210,22 @@ class InstantJoin(Operator):
             if jt in ("left", "full"):
                 self._emit(t, lb, None, None, None, collector)
             return
-        lk = lb.keys.astype(np.uint64).view(np.int64)
-        rk = rb.keys.astype(np.uint64).view(np.int64)
-        li, ri = _hash_join_indices(lk, rk)
+        if handle is not None:
+            li, ri = handle.result()
+        else:
+            lk = lb.keys.astype(np.uint64).view(np.int64)
+            rk = rb.keys.astype(np.uint64).view(np.int64)
+            li, ri = _hash_join_indices(lk, rk)
         out = []
         if len(li):
             out.append((lb.take(li), rb.take(ri)))
         if jt in ("left", "full"):
-            unmatched = np.ones(len(lk), dtype=bool)
+            unmatched = np.ones(lb.num_rows, dtype=bool)
             unmatched[li] = False
             if unmatched.any():
                 out.append((lb.filter(unmatched), None))
         if jt in ("right", "full"):
-            unmatched = np.ones(len(rk), dtype=bool)
+            unmatched = np.ones(rb.num_rows, dtype=bool)
             unmatched[ri] = False
             if unmatched.any():
                 out.append((None, rb.filter(unmatched)))
@@ -186,6 +252,9 @@ class InstantJoin(Operator):
         collector.collect(Batch(cols))
 
     def handle_checkpoint(self, barrier, ctx, collector):
+        # in-flight closes are no longer in self.buf: their rows must be
+        # emitted before the barrier, not lost from the snapshot
+        self._drain_pending(collector, force=True)
         for side, name in ((0, "left"), (1, "right")):
             tbl = ctx.table_manager.expiring_time_key(name)
             batches = []

@@ -19,7 +19,29 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Optional
+
+
+def wait_buffers_ready(bufs, deadline_s: float = 30.0) -> None:
+    """Poll device buffers' is_ready before materializing. Blocking
+    np.asarray on a buffer whose async copy is still in flight hits a
+    pathological multi-second stall on the remote-device tunnel (measured:
+    avg 1.8 s vs ~70 ms copy latency when polled); a 1 ms is_ready loop
+    materializes in 0.1 ms once the copy lands. Bounded: past the deadline
+    the caller's blocking asarray still raises if the device/link actually
+    failed (a bare poll loop would spin forever on a dead tunnel)."""
+    limit = time.monotonic() + deadline_s
+    try:
+        for buf in bufs:
+            if buf is None:
+                continue
+            while not buf.is_ready():
+                if time.monotonic() > limit:
+                    return
+                time.sleep(0.001)
+    except AttributeError:
+        return  # backend without is_ready: fall through to asarray
 
 
 class Future:

@@ -213,32 +213,10 @@ class SlotExtractHandle:
             for (_regs, ib, fb) in self._groups
         )
 
-    def _wait_ready(self, deadline_s: float = 30.0) -> None:
-        """Poll is_ready before materializing. Blocking np.asarray on a
-        buffer whose async copy is still in flight hits a pathological
-        multi-second stall on the remote-device tunnel (measured: avg 1.8 s
-        vs ~70 ms copy latency when polled); a 1 ms is_ready poll loop
-        materializes in 0.1 ms once the copy lands. Bounded: past the
-        deadline we fall through to the blocking asarray, which still
-        raises if the device/link actually failed (a bare poll loop would
-        spin forever on a dead tunnel)."""
-        import time
-
-        limit = time.monotonic() + deadline_s
-        for _regs, ib, fb in self._groups:
-            for buf in (ib, fb):
-                if buf is None:
-                    continue
-                try:
-                    while not buf.is_ready():
-                        if time.monotonic() > limit:
-                            return
-                        time.sleep(0.001)
-                except AttributeError:
-                    return  # backend without is_ready: fall through to asarray
-
     def result(self) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
-        self._wait_ready()
+        from .prefetch import wait_buffers_ready
+
+        wait_buffers_ready([b for (_r, ib, fb) in self._groups for b in (ib, fb)])
         agg = self._agg
         R = agg.region_size
         int_idx = [i for i, d in enumerate(agg.acc_dtypes)

@@ -62,6 +62,18 @@ class SlidingAggregate(Operator):
         self.max_bin: Optional[int] = None  # latest rel bin seen
         self.next_window: Optional[int] = None  # rel start-bin of next window to emit
         self.late_rows = 0
+        # device-path incremental extraction: each slide bin is fetched from
+        # the device EXACTLY ONCE (destructively) when the watermark completes
+        # it, asynchronously via the shared prefetcher; windows combine the
+        # host-cached bins. This replaces the nb-way-redundant synchronous
+        # scan-per-window (measured 38s for 1M events on the remote device
+        # link — one ~70ms fetch sync per window close).
+        self.open_bins: set[int] = set()  # rel bins with device-resident data
+        self._bin_cache: dict[int, tuple] = {}  # rel bin -> (keys_u64, accs)
+        self._bin_pending: dict = {}  # rel bin -> Future[(keys, bins, accs)]
+        self._extracted_before: Optional[int] = None
+        self._target_window: Optional[int] = None  # emit windows <= this
+        self._wm_queue: list = []  # (target_window, Watermark) held in order
 
     # ------------------------------------------------------------------
 
@@ -99,6 +111,7 @@ class SlidingAggregate(Operator):
         rel = (bins_abs - self.base_bin).astype(np.int32)
         accs = [b[f"__acc_{i}"].astype(d) for i, d in enumerate(self.acc_dtypes)]
         self._aggregator().restore(hashes, rel, accs)
+        self.open_bins = set(np.unique(rel).tolist())
         self.min_bin = int(rel.min())
         self.max_bin = int(rel.max())
         if "__next_window" in b:
@@ -113,14 +126,22 @@ class SlidingAggregate(Operator):
     # ------------------------------------------------------------------
 
     def process_batch(self, batch, ctx, collector, input_index=0):
+        if self._bin_pending or self._wm_queue:
+            self._drain(collector)
         ts = batch.timestamps
         bins_abs = ts // self.slide
         if self.base_bin is None:
             self.base_bin = int(bins_abs.min())
         rel = (bins_abs - self.base_bin).astype(np.int64)
-        if self.next_window is not None:
-            # a row whose own bin's last window already fired is late
-            late = rel < self.next_window
+        # a row is late if its bin's last window already fired, or (device
+        # path) the bin was already destructively extracted — both are
+        # watermark-contract violations by the producer
+        late_before = self.next_window
+        if self._extracted_before is not None:
+            late_before = (self._extracted_before if late_before is None
+                           else max(late_before, self._extracted_before))
+        if late_before is not None:
+            late = rel < late_before
             if late.any():
                 self.late_rows += int(late.sum())
                 if late.all():
@@ -141,6 +162,8 @@ class SlidingAggregate(Operator):
             else:
                 vals.append(np.asarray(eval_expr(inp, batch.columns, n)).astype(dt))
         self._aggregator().update(hashes, rel, vals)
+        if self.backend != "numpy":  # numpy path never reads the set
+            self.open_bins.update(np.unique(rel).tolist())
         lo, hi = int(rel.min()), int(rel.max())
         self.min_bin = lo if self.min_bin is None else min(self.min_bin, lo)
         self.max_bin = hi if self.max_bin is None else max(self.max_bin, hi)
@@ -149,22 +172,127 @@ class SlidingAggregate(Operator):
 
     def handle_watermark(self, watermark, ctx, collector):
         if watermark.is_idle:
+            self._drain(collector, force=True)
             return watermark
-        if self.base_bin is not None:
-            # window starting at rel bin B closes when wm >= (base+B)*slide + width
-            last_closed = (watermark.value - self.width) // self.slide - self.base_bin
-            self._emit_through(int(last_closed), collector)
         # future emissions are stamped with window starts strictly after the
         # last closed boundary; forward that lower bound (see tumbling)
         held = ((watermark.value - self.width) // self.slide + 1) * self.slide
-        return Watermark.event_time(min(watermark.value, held))
+        out_wm = Watermark.event_time(min(watermark.value, held))
+        if self.base_bin is None:
+            return out_wm
+        if self.backend == "numpy":
+            last_closed = (watermark.value - self.width) // self.slide - self.base_bin
+            self._emit_through(int(last_closed), collector)
+            return out_wm
+        # device path: bins complete once the watermark passes their end;
+        # dispatch their (destructive) extraction, then emit whatever windows
+        # have all bins resolved — later watermarks/batches drain the rest
+        complete_before = int(watermark.value // self.slide - self.base_bin)
+        self._dispatch_extracts(complete_before)
+        last_closed = int((watermark.value - self.width) // self.slide - self.base_bin)
+        if self._target_window is None or last_closed > self._target_window:
+            self._target_window = last_closed
+        self._drain(collector)
+        if self._caught_up() and not self._wm_queue:
+            return out_wm
+        self._wm_queue.append((self._target_window, out_wm))
+        return None
 
     def on_close(self, ctx, collector):
-        if self.max_bin is not None:
+        if self.max_bin is None:
+            return
+        if self.backend == "numpy":
             self._emit_through(self.max_bin, collector)
+            return
+        self._dispatch_extracts(self.max_bin + 1)
+        self._target_window = max(self._target_window or self.max_bin, self.max_bin)
+        self._drain(collector, force=True)
+
+    def _caught_up(self) -> bool:
+        return (self.next_window is None or self._target_window is None
+                or self.next_window > self._target_window)
+
+    def _dispatch_extracts(self, complete_before: int) -> None:
+        """Start the one-time extraction of every complete data-carrying bin
+        below complete_before (ascending, so the slot directory's monotone
+        close boundary is respected)."""
+        if self._extracted_before is not None and complete_before <= self._extracted_before:
+            return
+        ready = sorted(b for b in self.open_bins if b < complete_before)
+        if ready:
+            agg = self._aggregator()
+            from ..ops.prefetch import shared_prefetcher
+
+            pf = shared_prefetcher()
+            for b in ready:
+                handle = agg.extract_start(b, b + 1, b + 1)
+                self._bin_pending[b] = pf.submit(handle.result)
+                self.open_bins.discard(b)
+        self._extracted_before = complete_before
+
+    def _resolve_bins(self, bins: list[int], force: bool) -> bool:
+        """Move resolved futures into the cache; True when every requested
+        bin is available (cached or known-empty)."""
+        ok = True
+        for b in bins:
+            fut = self._bin_pending.get(b)
+            if fut is None:
+                continue
+            if force or fut.is_ready():
+                keys, _bins, accs = fut.result()
+                if len(keys):
+                    self._bin_cache[b] = (keys, accs)
+                del self._bin_pending[b]
+            else:
+                ok = False
+        return ok
+
+    def _drain(self, collector, force: bool = False) -> None:
+        """Emit in-order every window whose bins are all resolved, then
+        forward watermarks whose windows are out."""
+        from ..ops.aggregate import combine_by_key
+
+        while not self._caught_up():
+            w = self.next_window
+            # event-time gap fast-forward: if no bin anywhere could feed a
+            # window starting at w, jump straight to the earliest window the
+            # live data can touch (a clock jump would otherwise make this
+            # loop iterate once per empty slide bin across the gap)
+            live = [b for src in (self._bin_cache, self._bin_pending, self.open_bins)
+                    for b in src if b >= w]
+            if not live:
+                self.next_window = self._target_window + 1
+                self.key_dict.evict_closed(self.next_window)
+                break
+            earliest = min(live)
+            if earliest >= w + self.nb:
+                self.next_window = min(earliest - self.nb + 1, self._target_window + 1)
+                self.key_dict.evict_closed(self.next_window)
+                continue
+            needed = list(range(w, w + self.nb))
+            if not self._resolve_bins(needed, force):
+                break
+            parts = [self._bin_cache[b] for b in needed if b in self._bin_cache]
+            if parts:
+                keys = np.concatenate([p[0] for p in parts])
+                accs = [np.concatenate([p[1][i] for p in parts])
+                        for i in range(len(self.acc_kinds))]
+                keys_c, accs_c = combine_by_key(self.acc_kinds, keys, accs)
+                self._emit_window(w, keys_c, accs_c, collector)
+            self.next_window = w + 1
+            for b in [b for b in self._bin_cache if b < self.next_window]:
+                del self._bin_cache[b]
+            self.key_dict.evict_closed(self.next_window)
+        while self._wm_queue and (self.next_window is None
+                                  or self._wm_queue[0][0] < self.next_window):
+            _t, wm = self._wm_queue.pop(0)
+            from ..types import Signal
+
+            collector.broadcast(Signal.watermark_of(wm))
 
     def _emit_through(self, last_start_rel: int, collector) -> None:
-        """Emit every unfired window whose start bin is <= last_start_rel."""
+        """numpy-backend path: synchronous scan per window (the dict store
+        has no fetch latency to hide)."""
         if self.next_window is None:
             return
         agg = self._aggregator()
@@ -223,7 +351,20 @@ class SlidingAggregate(Operator):
     # ------------------------------------------------------------------
 
     def handle_checkpoint(self, barrier, ctx, collector):
+        # flush every emittable window first (rows precede the barrier), then
+        # fold host-cached bins — destructively extracted off the device but
+        # still feeding future windows — into the snapshot
+        self._drain(collector, force=True)
+        self._resolve_bins(sorted(self._bin_pending), force=True)
         keys, bins, accs = self._aggregator().snapshot()
+        cached = sorted(self._bin_cache)
+        if cached:
+            keys = np.concatenate([keys] + [self._bin_cache[b][0] for b in cached])
+            bins = np.concatenate(
+                [bins] + [np.full(len(self._bin_cache[b][0]), b, dtype=np.int32)
+                          for b in cached])
+            accs = [np.concatenate([a] + [self._bin_cache[b][1][i] for b in cached])
+                    for i, a in enumerate(accs)]
         tbl = ctx.table_manager.expiring_time_key("t", self.width)
         if len(keys) == 0:
             tbl.replace_all([])
