@@ -190,6 +190,18 @@ def _cmd_worker(args) -> int:
 
 
 def main(argv: Optional[list[str]] = None) -> int:
+    # Honor JAX_PLATFORMS even where a site-level shim force-selects a
+    # platform at interpreter startup (the axon TPU tunnel does this and is
+    # single-client: worker subprocesses spawned by the test/process
+    # scheduler must stay on the platform the parent chose for them, or a
+    # killed worker wedges the tunnel grant for every later worker).
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
     p = argparse.ArgumentParser(prog="arroyo_tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
 

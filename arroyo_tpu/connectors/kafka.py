@@ -234,18 +234,20 @@ class KafkaSink(Operator):
     def _marker_path(self, epoch: int, ctx) -> str:
         import os
 
+        from ..state import storage
+
         ti = ctx.task_info
         d = os.path.join(ctx.table_manager.storage_url, ti.job_id, "commits")
-        os.makedirs(d, exist_ok=True)
+        storage.makedirs(d)
         return os.path.join(d, f"{ti.node_id}-{ti.subtask_index:03d}-{epoch:07d}.done")
 
     def _commit_epoch(self, epoch: int, ctx) -> None:
-        import os
+        from ..state import storage
 
         payloads = self.pending.pop(epoch, None)
         if payloads is None:
             return
-        if os.path.exists(self._marker_path(epoch, ctx)):
+        if storage.exists(self._marker_path(epoch, ctx)):
             return  # committed in a previous incarnation; don't re-produce
         if payloads:
             self.producer.begin_transaction()
@@ -257,8 +259,9 @@ class KafkaSink(Operator):
         # re-produce this epoch on restore. (The marker-write itself leaves
         # a sub-millisecond window after broker commit — the unavoidable 2PC
         # residue without broker-side transaction resumption.)
-        with open(self._marker_path(epoch, ctx), "w") as f:
-            f.write("committed")
+        # markers live on the shared checkpoint store (durable + visible to a
+        # worker restarted on another machine), not the local disk
+        storage.write_text(self._marker_path(epoch, ctx), "committed")
         ctx.table_manager.global_keyed("p").insert(
             ctx.task_info.subtask_index,
             {"pending": [(e, list(p)) for e, p in self.pending.items()]},

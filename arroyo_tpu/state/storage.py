@@ -1,0 +1,200 @@
+"""URL-dispatched object storage behind checkpoints and file connectors.
+
+Equivalent of crates/arroyo-storage (StorageProvider, lib.rs:33 /
+BackendConfig, lib.rs:180): one path-string API that reads/writes local
+filesystems or S3-compatible object stores depending on the URL scheme —
+``/abs/path`` or ``file://`` for local, ``s3://bucket/prefix`` for object
+storage (boto3 when available; tests inject a fake client via
+``set_s3_client``). Directory-shaped operations (listdir/isdir/rmtree) are
+emulated on S3 with delimiter listings, mirroring how the reference treats
+checkpoint paths as key prefixes.
+
+All writes are atomic-publish: local files go through tmp + os.replace,
+S3 puts are atomic by the service's semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+_s3_client = None
+
+
+def set_s3_client(client) -> None:
+    """Inject an S3 client (tests: an in-memory fake; production may pass a
+    configured boto3 client to control credentials/endpoints)."""
+    global _s3_client
+    _s3_client = client
+
+
+def _get_s3():
+    global _s3_client
+    if _s3_client is None:
+        try:
+            import boto3  # type: ignore
+
+            _s3_client = boto3.client("s3")
+        except ImportError as e:
+            raise RuntimeError(
+                "s3:// storage requires boto3 (not installed) or an injected "
+                "client via arroyo_tpu.state.storage.set_s3_client"
+            ) from e
+    return _s3_client
+
+
+def _parse_s3(path: str) -> Optional[tuple[str, str]]:
+    if not path.startswith("s3://"):
+        return None
+    rest = path[len("s3://"):]
+    bucket, _, key = rest.partition("/")
+    return bucket, key.rstrip("/")
+
+
+def _local(path: str) -> str:
+    return path[len("file://"):] if path.startswith("file://") else path
+
+
+# ------------------------------------------------------------------ bytes
+
+
+def read_bytes(path: str) -> bytes:
+    s3 = _parse_s3(path)
+    if s3:
+        return _get_s3().get_object(Bucket=s3[0], Key=s3[1])["Body"].read()
+    with open(_local(path), "rb") as f:
+        return f.read()
+
+
+def write_bytes(path: str, data: bytes) -> None:
+    s3 = _parse_s3(path)
+    if s3:
+        _get_s3().put_object(Bucket=s3[0], Key=s3[1], Body=data)
+        return
+    p = _local(path)
+    tmp = p + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, p)
+
+
+def read_text(path: str) -> str:
+    return read_bytes(path).decode("utf-8")
+
+
+def write_text(path: str, text: str) -> None:
+    write_bytes(path, text.encode("utf-8"))
+
+
+# -------------------------------------------------------------- directory
+
+
+def makedirs(path: str) -> None:
+    if _parse_s3(path):
+        return  # prefixes need no creation
+    os.makedirs(_local(path), exist_ok=True)
+
+
+def _is_not_found(exc: Exception) -> bool:
+    """True only for a definitive not-found; transient S3 failures
+    (throttling, auth) must propagate — mapping them to "absent" would make
+    a committed checkpoint look incomplete and restore an older epoch."""
+    if isinstance(exc, (KeyError, FileNotFoundError)):
+        return True  # injected fake clients
+    resp = getattr(exc, "response", None)
+    if isinstance(resp, dict):
+        code = str(resp.get("Error", {}).get("Code", ""))
+        status = resp.get("ResponseMetadata", {}).get("HTTPStatusCode")
+        return code in ("404", "NoSuchKey", "NotFound") or status == 404
+    return False
+
+
+def exists(path: str) -> bool:
+    s3 = _parse_s3(path)
+    if s3:
+        try:
+            _get_s3().head_object(Bucket=s3[0], Key=s3[1])
+            return True
+        except Exception as e:
+            if _is_not_found(e):
+                return False
+            raise
+    return os.path.exists(_local(path))
+
+
+def isdir(path: str) -> bool:
+    s3 = _parse_s3(path)
+    if s3:
+        bucket, key = s3
+        resp = _get_s3().list_objects_v2(
+            Bucket=bucket, Prefix=key + "/", MaxKeys=1)
+        return resp.get("KeyCount", len(resp.get("Contents", []))) > 0
+    return os.path.isdir(_local(path))
+
+
+def listdir(path: str) -> list[str]:
+    """Immediate children (files and sub-prefixes), names only."""
+    s3 = _parse_s3(path)
+    if s3:
+        bucket, key = s3
+        prefix = key + "/" if key else ""
+        names = set()
+        token = None
+        while True:
+            kwargs = dict(Bucket=bucket, Prefix=prefix, Delimiter="/")
+            if token:
+                kwargs["ContinuationToken"] = token
+            resp = _get_s3().list_objects_v2(**kwargs)
+            for c in resp.get("Contents", []):
+                names.add(c["Key"][len(prefix):])
+            for p in resp.get("CommonPrefixes", []):
+                names.add(p["Prefix"][len(prefix):].rstrip("/"))
+            token = resp.get("NextContinuationToken")
+            if not token:
+                break
+        return sorted(n for n in names if n)
+    return sorted(os.listdir(_local(path)))
+
+
+def remove(path: str) -> None:
+    s3 = _parse_s3(path)
+    if s3:
+        _get_s3().delete_object(Bucket=s3[0], Key=s3[1])
+        return
+    os.remove(_local(path))
+
+
+def rmtree(path: str) -> None:
+    """Best-effort recursive delete (GC path — mirrors the local branch's
+    ignore_errors; a transient S3 failure must not crash the engine over a
+    cleanup step). S3 keys go through batched delete_objects (1000/request)
+    when the client supports it."""
+    s3 = _parse_s3(path)
+    if s3:
+        bucket, key = s3
+        client = _get_s3()
+        token = None
+        try:
+            while True:
+                kwargs = dict(Bucket=bucket, Prefix=key + "/")
+                if token:
+                    kwargs["ContinuationToken"] = token
+                resp = client.list_objects_v2(**kwargs)
+                keys = [c["Key"] for c in resp.get("Contents", [])]
+                if keys and hasattr(client, "delete_objects"):
+                    for i in range(0, len(keys), 1000):
+                        client.delete_objects(
+                            Bucket=bucket,
+                            Delete={"Objects": [{"Key": k} for k in keys[i:i + 1000]]},
+                        )
+                else:
+                    for k in keys:
+                        client.delete_object(Bucket=bucket, Key=k)
+                token = resp.get("NextContinuationToken")
+                if not token:
+                    break
+        except Exception:
+            pass
+        return
+    shutil.rmtree(_local(path), ignore_errors=True)

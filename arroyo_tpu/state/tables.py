@@ -18,6 +18,7 @@ watermarks; operators mirror it into these host tables at barrier time only
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import pickle
@@ -27,42 +28,77 @@ from typing import Any, Iterable, Optional
 
 import numpy as np
 
-# pyarrow's IO paths have shown flaky segfaults when many engine task
-# threads checkpoint while another engine restores in the same process (the
-# smoke-test pattern, even with use_threads=False and a module-global lock);
-# the default columnar checkpoint codec is therefore pure-numpy .npz, with
-# parquet available via ``checkpoint.file-format = "parquet"`` for
-# production deployments that want reference-compatible state files.
+# Parquet is the default checkpoint codec (reference-compatible state
+# files, crates/arroyo-state/src/parquet.rs:24); .npz remains as the
+# fallback codec via ``checkpoint.file-format = "npz"`` or when pyarrow is
+# unavailable. (A round-2 comment here blamed pyarrow for flaky segfaults
+# under concurrent checkpoint/restore; re-testing the full smoke pattern
+# found none — the real defect was the then-codec stringifying object
+# columns, which lost nullable-int typing. The IO lock stays as cheap
+# insurance around the C++ IO paths.)
 _PARQUET_IO_LOCK = threading.Lock()
 
 from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch, Schema
 from ..types import TaskInfo
+from . import storage
+
+
+def _parquet_available() -> bool:
+    try:
+        import pyarrow  # noqa: F401
+        import pyarrow.parquet  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
 
 
 def _checkpoint_format() -> str:
     from ..config import config
 
-    return config().get("checkpoint.file-format", "npz")
+    fmt = config().get("checkpoint.file-format", "parquet")
+    if fmt == "parquet" and not _parquet_available():
+        return "npz"
+    return fmt
+
+
+def _format_of(path: str) -> str:
+    """Codec of an existing state file, from its extension — restore must
+    read whatever the WRITER used (a checkpoint taken under the npz
+    fallback stays readable after pyarrow appears, and vice versa)."""
+    return "npz" if path.endswith(".npz") else "parquet"
 
 
 def write_columnar(path: str, columns: dict) -> None:
     """Write named columns to ``path`` in the configured codec. Object
-    (string) columns round-trip via a pickled sidecar entry."""
+    columns keep their python value types: pyarrow type inference for
+    parquet (nullable ints stay ints), a pickled sidecar for npz."""
     if _checkpoint_format() == "parquet":
         import pyarrow as pa
         import pyarrow.parquet as pq
 
         arrays, names = [], []
         for name, col in columns.items():
-            names.append(name)
             if col.dtype == object:
-                arrays.append(
-                    pa.array([None if v is None else str(v) for v in col], type=pa.string())
-                )
+                vals = [v.item() if isinstance(v, np.generic) else v for v in col]
+                try:
+                    arrays.append(pa.array(vals))
+                    names.append(name)
+                except (pa.ArrowInvalid, pa.ArrowTypeError):
+                    # heterogeneous python values: exact round trip via a
+                    # per-value pickled binary column (name-suffix marker)
+                    arrays.append(pa.array(
+                        [None if v is None else pickle.dumps(v) for v in vals],
+                        type=pa.binary(),
+                    ))
+                    names.append(name + "__pickled")
             else:
                 arrays.append(pa.array(col))
+                names.append(name)
+        buf = io.BytesIO()
         with _PARQUET_IO_LOCK:
-            pq.write_table(pa.table(arrays, names=names), path)
+            pq.write_table(pa.table(arrays, names=names), buf)
+        storage.write_bytes(path, buf.getvalue())
         return
     dense = {}
     objcols: dict[str, list] = {}
@@ -75,31 +111,38 @@ def write_columnar(path: str, columns: dict) -> None:
             dense[name] = col
     if objcols:
         dense["__objcols__"] = np.frombuffer(pickle.dumps(objcols), dtype=np.uint8)
-    with open(path, "wb") as f:
-        np.savez(f, **dense)
+    buf = io.BytesIO()
+    np.savez(buf, **dense)
+    storage.write_bytes(path, buf.getvalue())
 
 
 def read_columnar(path: str) -> dict:
-    if _checkpoint_format() == "parquet":
+    if _format_of(path) == "parquet":
         import pyarrow.parquet as pq
 
         with _PARQUET_IO_LOCK:
-            table = pq.read_table(path, use_threads=False)
+            table = pq.read_table(io.BytesIO(storage.read_bytes(path)), use_threads=False)
         cols: dict[str, np.ndarray] = {}
         for name in table.column_names:
             arr = table.column(name)
-            if str(arr.type) in ("string", "large_string"):
+            if name.endswith("__pickled"):
+                cols[name[: -len("__pickled")]] = np.array(
+                    [None if v is None else pickle.loads(v) for v in arr.to_pylist()],
+                    dtype=object,
+                )
+            elif str(arr.type) in ("string", "large_string", "null") or arr.null_count > 0:
+                # non-numeric or null-carrying: preserve python values
+                # (to_numpy would coerce nullable ints to float64 + NaN)
                 cols[name] = np.array(arr.to_pylist(), dtype=object)
             else:
                 cols[name] = np.asarray(arr.to_numpy(zero_copy_only=False))
         return cols
-    with open(path, "rb") as f:
-        data = np.load(f, allow_pickle=False)
-        cols = {name: data[name] for name in data.files if name != "__objcols__"}
-        if "__objcols__" in data.files:
-            objcols = pickle.loads(data["__objcols__"].tobytes())
-            for name, vals in objcols.items():
-                cols[name] = np.array(vals, dtype=object)
+    data = np.load(io.BytesIO(storage.read_bytes(path)), allow_pickle=False)
+    cols = {name: data[name] for name in data.files if name != "__objcols__"}
+    if "__objcols__" in data.files:
+        objcols = pickle.loads(data["__objcols__"].tobytes())
+        for name, vals in objcols.items():
+            cols[name] = np.array(vals, dtype=object)
     return cols
 
 
@@ -136,14 +179,12 @@ class GlobalKeyedTable:
     # -- checkpoint ---------------------------------------------------------
 
     def write_checkpoint(self, path: str) -> dict:
-        with open(path, "wb") as f:
-            pickle.dump(self.data, f)
+        storage.write_bytes(path, pickle.dumps(self.data))
         return {"file": os.path.basename(path), "kind": "global_keyed"}
 
     def load_files(self, paths: Iterable[str]) -> None:
         for p in paths:
-            with open(p, "rb") as f:
-                self.data.update(pickle.load(f))
+            self.data.update(pickle.loads(storage.read_bytes(p)))
 
 
 class ExpiringTimeKeyTable:
@@ -262,7 +303,7 @@ class TableManager:
         (reference: flusher write + OperatorCheckpointMetadata merge)."""
         ti = self.task_info
         opdir = operator_dir(self.storage_url, ti.job_id, epoch, ti.node_id)
-        os.makedirs(opdir, exist_ok=True)
+        storage.makedirs(opdir)
         sub = f"{ti.subtask_index:03d}"
         files = []
         for name, table in self.globals.items():
@@ -282,8 +323,7 @@ class TableManager:
             "watermark_micros": watermark_micros,
             "files": files,
         }
-        with open(os.path.join(opdir, f"metadata-{sub}.json"), "w") as f:
-            json.dump(meta, f)
+        storage.write_text(os.path.join(opdir, f"metadata-{sub}.json"), json.dumps(meta))
         return meta
 
     def restore(self, epoch: int, table_specs: list) -> Optional[int]:
@@ -301,12 +341,11 @@ class TableManager:
 
         def read_metas(d: str) -> list:
             out = []
-            if not os.path.isdir(d):
+            if not storage.isdir(d):
                 return out
-            for fn in sorted(os.listdir(d)):
+            for fn in storage.listdir(d):
                 if fn.startswith("metadata-") and fn.endswith(".json"):
-                    with open(os.path.join(d, fn)) as f:
-                        m = json.load(f)
+                    m = json.loads(storage.read_text(os.path.join(d, fn)))
                     m["__dir__"] = d
                     out.append(m)
             return out
@@ -355,13 +394,12 @@ def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
     Returns the number of files merged away.
     """
     opdir = operator_dir(storage_url, job_id, epoch, node_id)
-    if not os.path.isdir(opdir):
+    if not storage.isdir(opdir):
         return 0
     metas = []
-    for fn in sorted(os.listdir(opdir)):
+    for fn in storage.listdir(opdir):
         if fn.startswith("metadata-") and fn.endswith(".json"):
-            with open(os.path.join(opdir, fn)) as f:
-                metas.append((fn, json.load(f)))
+            metas.append((fn, json.loads(storage.read_text(os.path.join(opdir, fn)))))
     by_table: dict[str, list[dict]] = {}
     for _fn, m in metas:
         for fmeta in m["files"]:
@@ -379,10 +417,8 @@ def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
         if kind == "global_keyed":
             data: dict = {}
             for fm in fmetas:
-                with open(os.path.join(opdir, fm["file"]), "rb") as f:
-                    data.update(pickle.load(f))
-            with open(out_path, "wb") as f:
-                pickle.dump(data, f)
+                data.update(pickle.loads(storage.read_bytes(os.path.join(opdir, fm["file"]))))
+            storage.write_bytes(out_path, pickle.dumps(data))
             merged = dict(fmetas[0])
         else:
             col_parts = [read_columnar(os.path.join(opdir, fm["file"])) for fm in fmetas]
@@ -412,16 +448,13 @@ def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
         if m["subtask_index"] == min(mm["subtask_index"] for _f, mm in metas):
             kept.extend(merged_files.values())
         m["files"] = kept
-        tmp = os.path.join(opdir, fn + ".tmp")
-        with open(tmp, "w") as f:
-            json.dump(m, f)
-        os.replace(tmp, os.path.join(opdir, fn))
+        storage.write_text(os.path.join(opdir, fn), json.dumps(m))
     for fmetas in by_table.values():
         if len(fmetas) < 2:
             continue
         for fm in fmetas:
             try:
-                os.remove(os.path.join(opdir, fm["file"]))
+                storage.remove(os.path.join(opdir, fm["file"]))
                 removed += 1
             except FileNotFoundError:
                 pass
@@ -432,9 +465,9 @@ def compact_job(storage_url: str, job_id: str, epoch) -> int:
     """Compact every operator of one completed checkpoint."""
     cdir = checkpoint_dir(storage_url, job_id, epoch)
     total = 0
-    if not os.path.isdir(cdir):
+    if not storage.isdir(cdir):
         return 0
-    for fn in sorted(os.listdir(cdir)):
+    for fn in storage.listdir(cdir):
         if fn.startswith("operator-"):
             total += compact_operator(storage_url, job_id, epoch, fn[len("operator-"):])
     return total
@@ -444,20 +477,18 @@ def cleanup_checkpoints(storage_url: str, job_id: str, min_epoch: int) -> int:
     """Delete checkpoints below ``min_epoch`` (reference
     parquet.rs:214 cleanup_operator + controller epoch GC). The "final"
     drained-source snapshot is always kept. Returns dirs removed."""
-    import shutil
-
     base = os.path.join(storage_url, job_id, "checkpoints")
-    if not os.path.isdir(base):
+    if not storage.isdir(base):
         return 0
     removed = 0
-    for fn in sorted(os.listdir(base)):
+    for fn in storage.listdir(base):
         if not fn.startswith("checkpoint-"):
             continue
         tag = fn.split("-", 1)[1]
         if not tag.isdigit():
             continue  # "final" and friends
         if int(tag) < min_epoch:
-            shutil.rmtree(os.path.join(base, fn), ignore_errors=True)
+            storage.rmtree(os.path.join(base, fn))
             removed += 1
     return removed
 
@@ -468,27 +499,23 @@ def write_job_checkpoint_metadata(
     """Job-level commit marker once every subtask finished its snapshot
     (reference: controller CheckpointState -> CheckpointMetadata)."""
     d = checkpoint_dir(storage_url, job_id, epoch)
-    os.makedirs(d, exist_ok=True)
+    storage.makedirs(d)
     path = os.path.join(d, "metadata.json")
     payload = {"job_id": job_id, "epoch": epoch}
     if extra:
         payload.update(extra)
-    # atomic publish: the marker's existence declares the epoch complete, so
-    # a torn write must never be visible under the final name
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f)
-    os.replace(tmp, path)
+    # atomic publish: the marker's existence declares the epoch complete;
+    # storage.write_text lands via tmp+rename locally / atomic PUT on S3
+    storage.write_text(path, json.dumps(payload))
     return path
 
 
 def read_job_checkpoint_metadata(storage_url: str, job_id: str, epoch: int) -> Optional[dict]:
     path = os.path.join(checkpoint_dir(storage_url, job_id, epoch), "metadata.json")
-    if not os.path.exists(path):
+    if not storage.exists(path):
         return None
     try:
-        with open(path) as f:
-            return json.load(f)
+        return json.loads(storage.read_text(path))
     except (json.JSONDecodeError, OSError):
         # pre-atomic-write torn file: treat as metadata-less (restore
         # validation is skipped, matching pre-validation behavior)
@@ -497,10 +524,10 @@ def read_job_checkpoint_metadata(storage_url: str, job_id: str, epoch: int) -> O
 
 def latest_complete_checkpoint(storage_url: str, job_id: str) -> Optional[int]:
     base = os.path.join(storage_url, job_id, "checkpoints")
-    if not os.path.isdir(base):
+    if not storage.isdir(base):
         return None
     epochs = []
-    for fn in os.listdir(base):
-        if fn.startswith("checkpoint-") and os.path.exists(os.path.join(base, fn, "metadata.json")):
+    for fn in storage.listdir(base):
+        if fn.startswith("checkpoint-") and storage.exists(os.path.join(base, fn, "metadata.json")):
             epochs.append(int(fn.split("-")[1]))
     return max(epochs) if epochs else None
