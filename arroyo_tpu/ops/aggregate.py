@@ -59,6 +59,21 @@ def finalize_aggs(kinds: Sequence[str], acc_arrays: list[np.ndarray]) -> list[np
             s, c = acc_arrays[i], acc_arrays[i + 1]
             i += 2
             out.append(np.divide(s, np.maximum(c, 1)).astype(np.float64))
+        elif kind.startswith("udaf:"):
+            from ..batch import Field
+            from ..udf import lookup_udaf
+
+            udaf = lookup_udaf(kind[len("udaf:"):])
+            if udaf is None:
+                raise RuntimeError(f"UDAF {kind[5:]!r} no longer registered")
+            vals = [udaf.fn(np.asarray(lst)) for lst in acc_arrays[i]]
+            i += 1
+            if udaf.return_dtype == "string":
+                from ..batch import object_column
+
+                out.append(object_column(vals))
+            else:
+                out.append(np.array(vals, dtype=Field("_", udaf.return_dtype).numpy_dtype()))
         else:
             out.append(acc_arrays[i])
             i += 1

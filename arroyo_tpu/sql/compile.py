@@ -34,6 +34,12 @@ from .ast import (
 from .lexer import SqlError
 
 AGG_FUNCS = {"count", "sum", "min", "max", "avg"}
+
+
+def _is_udaf(name: str) -> bool:
+    from ..udf import lookup_udaf
+
+    return lookup_udaf(name) is not None
 WINDOW_TVFS = {"tumble", "hop", "session"}
 RANKING_FUNCS = {"row_number", "rank", "dense_rank"}
 
@@ -484,7 +490,7 @@ def find_aggregates(e: SqlExpr) -> list[FuncCall]:
     def rec(x: SqlExpr):
         if isinstance(x, OverExpr):
             return  # aggregates inside OVER belong to the window fn
-        if isinstance(x, FuncCall) and x.name in AGG_FUNCS:
+        if isinstance(x, FuncCall) and (x.name in AGG_FUNCS or _is_udaf(x.name)):
             out.append(x)
             return  # nested aggs are illegal anyway
         for child in _children(x):

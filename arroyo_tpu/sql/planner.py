@@ -622,6 +622,19 @@ class Planner:
             if a.name == "count":
                 aggregates.append((out, "count", None))
                 agg_out_dtypes[out] = "int64"
+            elif a.name not in ("sum", "min", "max", "avg"):
+                from ..udf import lookup_udaf
+
+                udaf = lookup_udaf(a.name)
+                if udaf is None:
+                    raise PlanError(f"unknown aggregate {a.name!r}")
+                if a.star or len(a.args) != 1:
+                    raise PlanError(
+                        f"UDAF {a.name}() takes exactly one argument"
+                    )
+                e = compile_expr(a.args[0], rel.scope)
+                aggregates.append((out, f"udaf:{udaf.name}", e))
+                agg_out_dtypes[out] = udaf.return_dtype
             else:
                 if a.star or not a.args:
                     raise PlanError(f"{a.name}(*) is not valid")
@@ -682,6 +695,10 @@ class Planner:
             agg_cfg["gap_micros"] = window.gap
         if rel.updating and window is not None:
             raise PlanError("windowed aggregates over updating inputs are unsupported")
+        if any(k.startswith("udaf:") for _n, k, _e in aggregates) and op != OpName.SESSION_AGGREGATE:
+            # UDAF state is host-resident collected values; the HBM window
+            # stores hold fixed-dtype accumulator lanes only
+            raise PlanError("UDAFs are currently supported in session windows only")
         aid = self._id("agg", op.value)
         self._add_node(aid, op, agg_cfg, parallelism=None if keyed else 1)
         self._edge(cur, aid, EdgeType.SHUFFLE if keyed else EdgeType.FORWARD, cur.schema())

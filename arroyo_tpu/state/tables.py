@@ -126,23 +126,28 @@ def read_columnar(path: str) -> dict:
         for name in table.column_names:
             arr = table.column(name)
             if name.endswith("__pickled"):
-                cols[name[: -len("__pickled")]] = np.array(
-                    [None if v is None else pickle.loads(v) for v in arr.to_pylist()],
-                    dtype=object,
+                from ..batch import object_column
+
+                cols[name[: -len("__pickled")]] = object_column(
+                    None if v is None else pickle.loads(v) for v in arr.to_pylist()
                 )
             elif str(arr.type) in ("string", "large_string", "null") or arr.null_count > 0:
                 # non-numeric or null-carrying: preserve python values
                 # (to_numpy would coerce nullable ints to float64 + NaN)
-                cols[name] = np.array(arr.to_pylist(), dtype=object)
+                from ..batch import object_column
+
+                cols[name] = object_column(arr.to_pylist())
             else:
                 cols[name] = np.asarray(arr.to_numpy(zero_copy_only=False))
         return cols
     data = np.load(io.BytesIO(storage.read_bytes(path)), allow_pickle=False)
     cols = {name: data[name] for name in data.files if name != "__objcols__"}
     if "__objcols__" in data.files:
+        from ..batch import object_column
+
         objcols = pickle.loads(data["__objcols__"].tobytes())
         for name, vals in objcols.items():
-            cols[name] = np.array(vals, dtype=object)
+            cols[name] = object_column(vals)
     return cols
 
 

@@ -109,6 +109,47 @@ def lookup_udf(name: str) -> Optional[Udf]:
     return _REGISTRY.get(name.lower())
 
 
+@dataclass
+class Udaf:
+    """User-defined aggregate (reference: custom UDAFs in
+    arroyo-planner/src/udafs.rs). The function receives the group's
+    collected input values as one numpy array and returns a scalar; state
+    between merges is the collected-value list (universally mergeable, like
+    the reference materializing UDAF inputs). Supported where aggregation
+    state is host-resident (session windows)."""
+
+    name: str
+    fn: Callable
+    return_dtype: str
+
+
+_UDAF_REGISTRY: dict[str, Udaf] = {}
+
+
+def register_udaf(name: str, fn: Optional[Callable] = None, *,
+                  return_dtype: str = "float64"):
+    """Register a Python UDAF usable from SQL. Decorator or direct call.
+
+    register_udaf("p95", lambda v: float(np.percentile(v, 95)))
+    """
+
+    def inner(f: Callable) -> Callable:
+        _UDAF_REGISTRY[name.lower()] = Udaf(name.lower(), f, return_dtype)
+        return f
+
+    if fn is not None:
+        return inner(fn)
+    return inner
+
+
+def lookup_udaf(name: str) -> Optional[Udaf]:
+    return _UDAF_REGISTRY.get(name.lower())
+
+
+def drop_udaf(name: str) -> None:
+    _UDAF_REGISTRY.pop(name.lower(), None)
+
+
 def drop_udf(name: str) -> None:
     _REGISTRY.pop(name.lower(), None)
 

@@ -34,6 +34,8 @@ from .tumbling import WINDOW_END, WINDOW_START, acc_plan
 def _combine(kind: str, a, b):
     if kind in ("sum", "count"):
         return a + b
+    if kind == "collect":  # UDAF state: collected values
+        return list(a) + list(b)
     if kind == "min":
         return min(a, b)
     return max(a, b)
@@ -99,7 +101,9 @@ class SessionAggregate(Operator):
         key_cols = [b[f] for f in self.key_fields]
         for j in range(b.num_rows):
             h = int(hashes[j])
-            accs = [d.type(b[f"__acc_{i}"][j]) for i, d in enumerate(self.acc_dtypes)]
+            accs = [list(b[f"__acc_{i}"][j]) if self.acc_kinds[i] == "collect"
+                    else d.type(b[f"__acc_{i}"][j])
+                    for i, d in enumerate(self.acc_dtypes)]
             self._merge_session(
                 h, int(b["__min_ts"][j]), int(b["__max_ts"][j]), accs
             )
@@ -162,6 +166,10 @@ class SessionAggregate(Operator):
         # per-accumulator values, segment-reduced per provisional run
         vals = []
         for inp, dt, kind in zip(self.acc_inputs, self.acc_dtypes, self.acc_kinds):
+            if kind == "collect":
+                v = np.asarray(eval_expr(inp, batch.columns, n))[order]
+                vals.append([v[si:ei].tolist() for si, ei in zip(starts, ends)])
+                continue
             if inp is None:
                 v = np.ones(n, dtype=dt)
             else:
@@ -180,7 +188,9 @@ class SessionAggregate(Operator):
                 if h not in self.key_values:
                     self.key_values[h] = tuple(c[si] for c in cols)
         for i, (si, ei) in enumerate(zip(starts, ends)):
-            accs = [self.acc_dtypes[j].type(vals[j][i]) for j in range(len(vals))]
+            accs = [vals[j][i] if self.acc_kinds[j] == "collect"
+                    else self.acc_dtypes[j].type(vals[j][i])
+                    for j in range(len(vals))]
             self._merge_session(int(k_s[si]), int(t_s[si]), int(t_s[ei - 1]), accs)
 
     # ------------------------------------------------------------------
@@ -246,8 +256,12 @@ class SessionAggregate(Operator):
                     cols[f] = np.array(vals)
         cols[WINDOW_START] = starts
         cols[WINDOW_END] = ends
+        from ..batch import object_column
+
         acc_arrays = [
-            np.array([s.accs[i] for _h, s in rows], dtype=d)
+            object_column(s.accs[i] for _h, s in rows)
+            if self.acc_kinds[i] == "collect"
+            else np.array([s.accs[i] for _h, s in rows], dtype=d)
             for i, d in enumerate(self.acc_dtypes)
         ]
         finals = finalize_aggs([a[1] for a in self.aggregates], acc_arrays)
@@ -283,8 +297,13 @@ class SessionAggregate(Operator):
             "__min_ts": np.array([s.min_ts for _h, s in items], dtype=np.int64),
             "__max_ts": np.array([s.max_ts for _h, s in items], dtype=np.int64),
         }
+        from ..batch import object_column
+
         for i, d in enumerate(self.acc_dtypes):
-            cols[f"__acc_{i}"] = np.array([s.accs[i] for _h, s in items], dtype=d)
+            if self.acc_kinds[i] == "collect":
+                cols[f"__acc_{i}"] = object_column(list(s.accs[i]) for _h, s in items)
+            else:
+                cols[f"__acc_{i}"] = np.array([s.accs[i] for _h, s in items], dtype=d)
         if self.key_fields:
             for j, f in enumerate(self.key_fields):
                 vals = [

@@ -59,6 +59,12 @@ def acc_plan(aggregates: list[tuple[str, str, Optional[Expr]]], schema_dtype_of)
             kinds.extend(["sum", "count"])
             dtypes.extend([np.dtype(np.float64), np.dtype(np.int64)])
             inputs.extend([expr, None])
+        elif kind.startswith("udaf:"):
+            # UDAF state = collected input values (host-resident python
+            # lists; the planner restricts these to session windows)
+            kinds.append("collect")
+            dtypes.append(np.dtype(object))
+            inputs.append(expr)
         else:
             kinds.append(kind)
             dtypes.append(schema_dtype_of(expr))

@@ -373,8 +373,135 @@ def o_having_filter(ins):
     ]
 
 
+def o_nexmark_q1(ins):
+    return [
+        {"auction": r["auction"], "price_eur": r["price"] * 89 // 100,
+         "bidder": r["bidder"]}
+        for r in ins["bids"]
+    ]
+
+
+def o_nexmark_q2(ins):
+    return [
+        {"auction": r["auction"], "price": r["price"]}
+        for r in ins["bids"]
+        if r["auction"] in (1000, 1200, 1400)
+    ]
+
+
+def o_nexmark_q7(ins):
+    W = 10 * S
+    per = defaultdict(int)
+    glob = defaultdict(int)
+    for r in ins["bids"]:
+        w = tumble_start(input_ts(r, "datetime"), W)
+        per[(w, r["auction"])] = max(per[(w, r["auction"])], r["price"])
+        glob[w] = max(glob[w], r["price"])
+    return [
+        {"auction": a, "price": p}
+        for (w, a), p in sorted(per.items())
+        if p == glob[w]
+    ]
+
+
+def o_every_aggregate(ins):
+    W = 20 * S
+    byw = defaultdict(list)
+    for r in ins["orders"]:
+        byw[tumble_start(input_ts(r, "timestamp"), W)].append(r["amount"])
+    return [
+        {"start": iso(w), "n": len(a), "total": sum(a), "lo": min(a),
+         "hi": max(a), "mean": sum(a) / len(a),
+         "dbl_total": sum(x * 2 for x in a),
+         "shifted_lo": min(a) + 100}
+        for w, a in sorted(byw.items())
+    ]
+
+
+def o_session_udaf(ins):
+    gap = 5 * S
+    byc = defaultdict(list)
+    for r in ins["orders"]:
+        byc[r["customer_id"]].append((input_ts(r, "timestamp"), r["amount"]))
+    out = []
+    for c, rows in sorted(byc.items()):
+        rows.sort()
+        # split into sessions by gap, mirroring sessions()
+        cur: list = []
+        groups = []
+        last = None
+        for t, amt in rows:
+            if last is not None and t - last > gap:
+                groups.append(cur)
+                cur = []
+            cur.append((t, amt))
+            last = t
+        if cur:
+            groups.append(cur)
+        for g in groups:
+            amts = [a for _t, a in g]
+            # p90 mirrors numpy.percentile(linear interpolation)
+            import numpy as _np
+
+            out.append({
+                "start": iso(g[0][0]), "customer_id": c, "n": len(g),
+                "p90_amount": float(_np.percentile(_np.array(amts, dtype=float), 90)),
+                "spread": max(amts) - min(amts),
+            })
+    return out
+
+
+def o_windowed_left_join(ins):
+    W = 20 * S
+    pick = defaultdict(int)
+    drop = defaultdict(int)
+    for r in ins["cars"]:
+        k = (tumble_start(input_ts(r, "timestamp"), W), r["driver_id"])
+        if r["event_type"] == "pickup":
+            pick[k] += 1
+        if r["event_type"] == "dropoff" and r["driver_id"] % 3 == 0:
+            drop[k] += 1
+    return [
+        {"driver_id": d, "pickups": p, "dropoffs": drop.get((w, d))}
+        for (w, d), p in sorted(pick.items())
+    ]
+
+
+def o_string_keys(ins):
+    W = 20 * S
+    byk = defaultdict(int)
+    for r in ins["cars"]:
+        byk[(tumble_start(input_ts(r, "timestamp"), W), r["location"], r["event_type"])] += 1
+    return [
+        {"start": iso(w), "location": loc, "event_type": et, "events": n}
+        for (w, loc, et), n in sorted(byk.items())
+    ]
+
+
+def o_nested_subquery(ins):
+    W = 10 * S
+    byk = defaultdict(int)
+    for r in ins["cars"]:
+        byk[(tumble_start(input_ts(r, "timestamp"), W), r["driver_id"])] += 1
+    byw = defaultdict(list)
+    for (w, _d), n in byk.items():
+        byw[w].append(n)
+    return [
+        {"busiest_driver_events": max(ns), "drivers": len(ns)}
+        for w, ns in sorted(byw.items())
+    ]
+
+
 ORACLES = {
     "select_star": o_select_star,
+    "nexmark_q1": o_nexmark_q1,
+    "nexmark_q2": o_nexmark_q2,
+    "nexmark_q7": o_nexmark_q7,
+    "every_aggregate": o_every_aggregate,
+    "session_udaf": o_session_udaf,
+    "windowed_left_join": o_windowed_left_join,
+    "string_keys": o_string_keys,
+    "nested_subquery": o_nested_subquery,
     "expressions": o_expressions,
     "tumbling_aggregates": o_tumbling_aggregates,
     "grouped_aggregates": o_grouped_aggregates,

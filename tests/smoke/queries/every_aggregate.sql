@@ -1,0 +1,40 @@
+CREATE TABLE orders (
+  timestamp TIMESTAMP,
+  order_id BIGINT,
+  customer_id BIGINT,
+  amount BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/orders.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE agg_out (
+  start TIMESTAMP,
+  n BIGINT,
+  total BIGINT,
+  lo BIGINT,
+  hi BIGINT,
+  mean DOUBLE,
+  dbl_total BIGINT,
+  shifted_lo BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO agg_out
+SELECT window.start AS start, n, total, lo, hi, mean, dbl_total, shifted_lo FROM (
+  SELECT tumble(interval '20 seconds') AS window,
+    count(*) AS n,
+    sum(amount) AS total,
+    min(amount) AS lo,
+    max(amount) AS hi,
+    avg(amount) AS mean,
+    sum(amount * 2) AS dbl_total,
+    min(amount + 100) AS shifted_lo
+  FROM orders
+  GROUP BY window
+) x;

@@ -1,0 +1,28 @@
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE busiest (
+  busiest_driver_events BIGINT,
+  drivers BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO busiest
+SELECT max(n) AS busiest_driver_events, count(*) AS drivers FROM (
+  SELECT driver_id, count(*) AS n, tumble(interval '10 seconds') AS window
+  FROM cars
+  GROUP BY driver_id, window
+) t
+GROUP BY t.window;

@@ -1,0 +1,24 @@
+CREATE TABLE bids (
+  datetime TIMESTAMP,
+  auction BIGINT,
+  price BIGINT,
+  bidder TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/bids.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'datetime'
+);
+CREATE TABLE converted (
+  auction BIGINT,
+  price_eur BIGINT,
+  bidder TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO converted
+SELECT auction, price * 89 / 100 AS price_eur, bidder FROM bids;

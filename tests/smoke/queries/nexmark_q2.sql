@@ -1,0 +1,24 @@
+CREATE TABLE bids (
+  datetime TIMESTAMP,
+  auction BIGINT,
+  price BIGINT,
+  bidder TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/bids.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'datetime'
+);
+CREATE TABLE selected (
+  auction BIGINT,
+  price BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO selected
+SELECT auction, price FROM bids
+WHERE auction = 1000 OR auction = 1200 OR auction = 1400;

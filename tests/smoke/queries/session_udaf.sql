@@ -1,0 +1,33 @@
+CREATE TABLE orders (
+  timestamp TIMESTAMP,
+  order_id BIGINT,
+  customer_id BIGINT,
+  amount BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/orders.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE spend_sessions (
+  start TIMESTAMP,
+  customer_id BIGINT,
+  n BIGINT,
+  p90_amount DOUBLE,
+  spread BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO spend_sessions
+SELECT window.start AS start, customer_id, n, p90_amount, spread FROM (
+  SELECT session(interval '5 seconds') AS window, customer_id,
+    count(*) AS n,
+    p90(amount) AS p90_amount,
+    val_range(amount) AS spread
+  FROM orders
+  GROUP BY window, customer_id
+) x;

@@ -1,0 +1,30 @@
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE by_location (
+  start TIMESTAMP,
+  location TEXT,
+  event_type TEXT,
+  events BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO by_location
+SELECT window.start AS start, location, event_type, events FROM (
+  SELECT tumble(interval '20 seconds') AS window, location, event_type,
+    count(*) AS events
+  FROM cars
+  GROUP BY window, location, event_type
+) x;

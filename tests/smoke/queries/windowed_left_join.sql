@@ -1,0 +1,35 @@
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE join_output (
+  driver_id BIGINT,
+  pickups BIGINT,
+  dropoffs BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO join_output
+SELECT p.driver_id, p.pickups, d.dropoffs
+FROM (
+  SELECT tumble(interval '20 seconds') AS window, driver_id, count(*) AS pickups
+  FROM cars WHERE event_type = 'pickup'
+  GROUP BY window, driver_id
+) p
+LEFT JOIN (
+  SELECT tumble(interval '20 seconds') AS window, driver_id, count(*) AS dropoffs
+  FROM cars WHERE event_type = 'dropoff' AND driver_id % 3 = 0
+  GROUP BY window, driver_id
+) d
+ON p.driver_id = d.driver_id AND p.window = d.window;

@@ -1,0 +1,21 @@
+"""Test UDF/UDAF fixtures for the smoke suite (the reference registers its
+test UDFs from fixture sources the same way, arroyo-planner test/udfs/).
+Importing this module registers them; generate.py mirrors the math in its
+oracles."""
+
+import numpy as np
+
+from arroyo_tpu.udf import register_udaf
+
+
+def p90(values: np.ndarray) -> float:
+    return float(np.percentile(np.asarray(values, dtype=np.float64), 90))
+
+
+def val_range(values: np.ndarray) -> int:
+    v = np.asarray(values)
+    return int(v.max() - v.min())
+
+
+register_udaf("p90", p90, return_dtype="float64")
+register_udaf("val_range", val_range, return_dtype="int64")

@@ -122,6 +122,13 @@ def assert_outputs(name: str, output_path: str):
 
 
 def build(sql: str, parallelism: int, job_id: str, restore_epoch=None):
+    import sys
+
+    sys.path.insert(0, SMOKE)
+    try:
+        import udfs  # noqa: F401  (registers the suite's test UDAFs)
+    finally:
+        sys.path.pop(0)
     from arroyo_tpu.engine.engine import Engine
     from arroyo_tpu.sql import plan_query
     from arroyo_tpu.sql.planner import set_parallelism
