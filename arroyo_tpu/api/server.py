@@ -90,6 +90,12 @@ class ApiServer:
         ("GET", r"^/api/v1/jobs/([^/]+)/output$", "_job_output"),
         ("GET", r"^/api/v1/jobs/([^/]+)/metrics$", "_job_metrics"),
         ("GET", r"^/api/v1/connectors$", "_connectors"),
+        ("POST", r"^/api/v1/nodes/register$", "_register_node"),
+        ("POST", r"^/api/v1/nodes/([^/]+)/heartbeat$", "_node_heartbeat"),
+        ("GET", r"^/api/v1/nodes$", "_list_nodes"),
+        ("POST", r"^/api/v1/udfs$", "_create_udf"),
+        ("GET", r"^/api/v1/udfs$", "_list_udfs"),
+        ("DELETE", r"^/api/v1/udfs/([^/]+)$", "_delete_udf"),
     ]
 
     def _route(self, h, method: str) -> None:
@@ -111,16 +117,90 @@ class ApiServer:
     def _ping(self, h):
         h._json(200, {"pong": True})
 
+    def _activate_udfs(self) -> None:
+        from ..compiler import activate_udf_specs
+
+        rows = self.db.list_udfs()
+        # registry is process-global: re-executing N user sources on every
+        # validate/create request is waste — only re-activate on change
+        fp = tuple(sorted((r["name"], r["created_at"], r["source"]) for r in rows))
+        if fp == getattr(self, "_udf_fingerprint", None):
+            return
+        activate_udf_specs(rows)
+        self._udf_fingerprint = fp
+
     def _validate(self, h):
         from ..sql import plan_query
         from ..sql.lexer import SqlError
 
         body = h._body()
         try:
+            self._activate_udfs()
             plan_query(body.get("query", ""))
             h._json(200, {"valid": True, "errors": []})
         except SqlError as e:
             h._json(200, {"valid": False, "errors": [str(e)]})
+
+    def _register_node(self, h):
+        body = h._body()
+        self.db.register_node(body["node_id"], body["addr"], int(body.get("slots", 16)))
+        h._json(200, {"registered": body["node_id"]})
+
+    def _node_heartbeat(self, h, node_id):
+        if self.db.node_heartbeat(node_id):
+            h._json(200, {})
+        else:
+            h._json(404, {"error": "unknown node (re-register)"})
+
+    def _list_nodes(self, h):
+        h._json(200, {"nodes": self.db.list_nodes()})
+
+    def _create_udf(self, h):
+        """Create a UDF: cpp sources compile through the CompileService
+        (artifact pushed to storage); python sources are stored and executed
+        at plan/worker start (reference: POST /udfs + compiler service)."""
+        from ..compiler import CompileError, CompileService, activate_udf_specs
+
+        body = h._body()
+        name = body.get("name")
+        language = body.get("language", "cpp")
+        source = body.get("source")
+        if not name or not source:
+            h._json(400, {"error": "name and source are required"})
+            return
+        artifact = None
+        arg_dtypes = list(body.get("arg_dtypes", []))
+        return_dtype = body.get("return_dtype", "float64")
+        try:
+            if language == "cpp":
+                spec = CompileService().build_udf(name, source, arg_dtypes, return_dtype)
+                artifact = spec.artifact_url
+            # activate FIRST: a source that fails to compile/exec must never
+            # be persisted, or it would poison every later validate/create
+            activate_udf_specs([{
+                "name": name, "language": language, "source": source,
+                "arg_dtypes": arg_dtypes, "return_dtype": return_dtype,
+                "artifact_url": artifact,
+            }])
+            self.db.create_udf(name, language, source, arg_dtypes, return_dtype, artifact)
+        except (CompileError, Exception) as e:  # noqa: B014 - user code raises anything
+            h._json(400, {"error": f"UDF rejected: {e}"})
+            return
+        h._json(200, {"name": name, "language": language, "artifact_url": artifact})
+
+    def _list_udfs(self, h):
+        h._json(200, {"udfs": [
+            {k: u[k] for k in ("name", "language", "return_dtype", "arg_dtypes", "artifact_url")}
+            for u in self.db.list_udfs()
+        ]})
+
+    def _delete_udf(self, h, name):
+        from ..udf import drop_udaf, drop_udf
+
+        self.db.delete_udf(name)
+        drop_udf(name)
+        drop_udaf(name)
+        h._json(200, {"deleted": name})
 
     def _create_pipeline(self, h):
         from ..sql import plan_query
@@ -133,6 +213,7 @@ class ApiServer:
             h._json(400, {"error": "query is required"})
             return
         try:
+            self._activate_udfs()
             plan_query(query)
         except SqlError as e:
             h._json(400, {"error": f"invalid query: {e}"})

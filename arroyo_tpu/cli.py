@@ -45,7 +45,7 @@ def _cmd_run(args) -> int:
         sql = f.read()
     db = Database(args.db or ":memory:")
     api = ApiServer(db, port=args.api_port).start()
-    controller = ControllerServer(db, scheduler_for(args.scheduler)).start()
+    controller = ControllerServer(db, scheduler_for(args.scheduler, db)).start()
     pid = db.create_pipeline(os.path.basename(args.sql_file), sql, args.parallelism)
     jid = db.create_job(pid)
     print(f"pipeline {pid} job {jid} (api on :{api.port})", file=sys.stderr)
@@ -85,7 +85,7 @@ def _cmd_cluster(args) -> int:
     AdminServer("cluster", port=_cfg().get("admin.http-port", 0)).start()
     db = Database(args.db or ":memory:")
     api = ApiServer(db, port=args.api_port).start()
-    controller = ControllerServer(db, scheduler_for(args.scheduler)).start()
+    controller = ControllerServer(db, scheduler_for(args.scheduler, db)).start()
     print(f"cluster up: api on :{api.port}", file=sys.stderr)
     try:
         while True:
@@ -131,6 +131,11 @@ def _cmd_worker(args) -> int:
         sys.stdout.write(json.dumps(obj) + "\n")
         sys.stdout.flush()
 
+    if getattr(args, "udfs_file", None):
+        from arroyo_tpu.compiler import activate_udf_specs
+
+        with open(args.udfs_file) as f:
+            activate_udf_specs(json.load(f))
     with open(args.sql_file) as f:
         sql = f.read()
     pp = plan_query(sql)
@@ -189,6 +194,24 @@ def _cmd_worker(args) -> int:
         time.sleep(0.05)
 
 
+def _cmd_node(args) -> int:
+    """Per-machine node daemon (reference `arroyo node`): registers with the
+    cluster API and launches worker processes the controller places here."""
+    import arroyo_tpu
+    from arroyo_tpu.controller.node import NodeServer
+
+    arroyo_tpu._load_operators()
+    node = NodeServer(args.controller, slots=args.slots, port=args.port,
+                      host=args.host, advertise_host=args.advertise_host).start()
+    print(f"node {node.node_id} on :{node.port} -> {args.controller}", file=sys.stderr)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        node.stop()
+        return 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     # Honor JAX_PLATFORMS even where a site-level shim force-selects a
     # platform at interpreter startup (the axon TPU tunnel does this and is
@@ -215,7 +238,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     rp.set_defaults(fn=_cmd_run)
 
     cp = sub.add_parser("cluster", help="api + controller, submit jobs over REST")
-    cp.add_argument("--scheduler", default="process", choices=["embedded", "process"])
+    cp.add_argument("--scheduler", default="process",
+                    choices=["embedded", "process", "node"])
     cp.add_argument("--api-port", type=int, default=5115)
     cp.add_argument("--db", default=None)
     cp.set_defaults(fn=_cmd_cluster)
@@ -231,6 +255,19 @@ def main(argv: Optional[list[str]] = None) -> int:
     wp.add_argument("--parallelism", type=int, default=1)
     wp.add_argument("--restore-epoch", type=int, default=None)
     wp.add_argument("--storage-url", default=None)
+    wp.add_argument("--udfs-file", default=None)
+
+    np_ = sub.add_parser("node", help="per-machine worker launcher daemon")
+    np_.add_argument("--controller", required=True,
+                     help="cluster API base url, e.g. http://host:5115")
+    np_.add_argument("--slots", type=int, default=16)
+    np_.add_argument("--port", type=int, default=0)
+    np_.add_argument("--host", default="0.0.0.0",
+                     help="bind address for the node's HTTP surface")
+    np_.add_argument("--advertise-host", default=None,
+                     help="routable hostname the controller should dial "
+                          "(default: the bind host)")
+    np_.set_defaults(fn=_cmd_node)
     wp.set_defaults(fn=_cmd_worker)
 
     vp = sub.add_parser("visualize", help="print the dataflow graph as dot")

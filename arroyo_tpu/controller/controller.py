@@ -124,7 +124,7 @@ class JobController:
             self.restarts = int(job["restarts"])
         self.handle = self.scheduler.start_worker(
             self.sql, self.job_id, self.parallelism, self.restore_epoch,
-            self.storage_url,
+            self.storage_url, udf_specs=self.db.list_udfs(),
         )
         self.running_since = time.monotonic()
         self.last_checkpoint_time = time.monotonic()
@@ -222,7 +222,8 @@ class ControllerServer:
     def __init__(self, db: Database, scheduler: Optional[Scheduler] = None,
                  storage_url: Optional[str] = None, poll_interval: float = 0.1):
         self.db = db
-        self.scheduler = scheduler or scheduler_for(config().get("controller.scheduler"))
+        self.scheduler = scheduler or scheduler_for(
+            config().get("controller.scheduler"), db)
         self.storage_url = storage_url
         self.poll_interval = poll_interval
         self.jobs: dict[str, JobController] = {}

@@ -36,6 +36,21 @@ CREATE TABLE IF NOT EXISTS jobs (
     run_id INTEGER NOT NULL DEFAULT 0,
     updated_at REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS nodes (
+    id TEXT PRIMARY KEY,
+    addr TEXT NOT NULL,
+    slots INTEGER NOT NULL,
+    last_heartbeat REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS udfs (
+    name TEXT PRIMARY KEY,
+    language TEXT NOT NULL,       -- 'cpp' | 'python'
+    source TEXT NOT NULL,
+    arg_dtypes TEXT NOT NULL,     -- JSON list (cpp only)
+    return_dtype TEXT NOT NULL,
+    artifact_url TEXT,            -- built dylib (cpp only)
+    created_at REAL NOT NULL
+);
 CREATE TABLE IF NOT EXISTS checkpoints (
     job_id TEXT NOT NULL,
     epoch INTEGER NOT NULL,
@@ -139,6 +154,68 @@ class Database:
                 f"UPDATE jobs SET {cols}, updated_at=? WHERE id=?",
                 (*fields.values(), time.time(), jid),
             )
+            self._conn.commit()
+
+    # ----------------------------------------------------------------- nodes
+
+    def register_node(self, node_id: str, addr: str, slots: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO nodes (id, addr, slots, last_heartbeat) VALUES (?,?,?,?) "
+                "ON CONFLICT(id) DO UPDATE SET addr=excluded.addr, "
+                "slots=excluded.slots, last_heartbeat=excluded.last_heartbeat",
+                (node_id, addr, slots, time.time()),
+            )
+            self._conn.commit()
+
+    def node_heartbeat(self, node_id: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE nodes SET last_heartbeat=? WHERE id=?", (time.time(), node_id)
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    def list_nodes(self, alive_within_s: Optional[float] = None) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute("SELECT * FROM nodes ORDER BY id").fetchall()
+        out = [dict(r) for r in rows]
+        if alive_within_s is not None:
+            cutoff = time.time() - alive_within_s
+            out = [n for n in out if n["last_heartbeat"] >= cutoff]
+        return out
+
+    # ------------------------------------------------------------------ udfs
+
+    def create_udf(self, name: str, language: str, source: str,
+                   arg_dtypes: list[str], return_dtype: str,
+                   artifact_url: Optional[str]) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO udfs (name, language, source, arg_dtypes, "
+                "return_dtype, artifact_url, created_at) VALUES (?,?,?,?,?,?,?) "
+                "ON CONFLICT(name) DO UPDATE SET language=excluded.language, "
+                "source=excluded.source, arg_dtypes=excluded.arg_dtypes, "
+                "return_dtype=excluded.return_dtype, "
+                "artifact_url=excluded.artifact_url",
+                (name, language, source, json.dumps(arg_dtypes), return_dtype,
+                 artifact_url, time.time()),
+            )
+            self._conn.commit()
+
+    def list_udfs(self) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute("SELECT * FROM udfs ORDER BY name").fetchall()
+        out = []
+        for r in rows:
+            d = dict(r)
+            d["arg_dtypes"] = json.loads(d["arg_dtypes"])
+            out.append(d)
+        return out
+
+    def delete_udf(self, name: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM udfs WHERE name=?", (name,))
             self._conn.commit()
 
     # ---------------------------------------------------------- checkpoints

@@ -135,7 +135,8 @@ class ProcessWorkerHandle(WorkerHandle):
     schedulers/mod.rs:72: spawns `arroyo worker` with env-injected config)."""
 
     def __init__(self, sql: str, job_id: str, parallelism: int,
-                 restore_epoch: Optional[int], storage_url: Optional[str] = None):
+                 restore_epoch: Optional[int], storage_url: Optional[str] = None,
+                 udf_specs: Optional[list] = None):
         import tempfile
 
         self._sql_file = tempfile.NamedTemporaryFile(
@@ -153,6 +154,15 @@ class ProcessWorkerHandle(WorkerHandle):
             cmd += ["--restore-epoch", str(restore_epoch)]
         if storage_url:
             cmd += ["--storage-url", storage_url]
+        self._udfs_file: Optional[str] = None
+        if udf_specs:
+            uf = tempfile.NamedTemporaryFile(
+                "w", suffix=".json", prefix=f"{job_id}-udfs-", delete=False
+            )
+            json.dump(udf_specs, uf)
+            uf.close()
+            self._udfs_file = uf.name
+            cmd += ["--udfs-file", uf.name]
         self.proc = subprocess.Popen(
             cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True, bufsize=1,
@@ -197,10 +207,12 @@ class ProcessWorkerHandle(WorkerHandle):
     def kill(self) -> None:
         if self.proc.poll() is None:
             self.proc.kill()
-        try:
-            os.unlink(self._sql_file.name)
-        except OSError:
-            pass
+        for path in (self._sql_file.name, self._udfs_file):
+            if path:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     def poll_events(self) -> list[dict]:
         out = []
@@ -222,23 +234,124 @@ class Scheduler:
 
     def start_worker(self, sql: str, job_id: str, parallelism: int,
                      restore_epoch: Optional[int],
-                     storage_url: Optional[str] = None) -> WorkerHandle:
+                     storage_url: Optional[str] = None,
+                     udf_specs: Optional[list] = None) -> WorkerHandle:
         raise NotImplementedError
 
 
 class EmbeddedScheduler(Scheduler):
-    def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None):
+    def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
+                     udf_specs=None):
+        if udf_specs:
+            from ..compiler import activate_udf_specs
+
+            activate_udf_specs(udf_specs)
         return EmbeddedWorkerHandle(sql, job_id, parallelism, restore_epoch, storage_url)
 
 
 class ProcessScheduler(Scheduler):
-    def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None):
-        return ProcessWorkerHandle(sql, job_id, parallelism, restore_epoch, storage_url)
+    def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
+                     udf_specs=None):
+        return ProcessWorkerHandle(sql, job_id, parallelism, restore_epoch, storage_url,
+                                   udf_specs)
 
 
-def scheduler_for(name: str) -> Scheduler:
+class NodeWorkerHandle(WorkerHandle):
+    """Controller-side proxy for a worker running under a remote node
+    daemon (reference NodeScheduler, schedulers/mod.rs:316): commands go
+    over the node's HTTP surface; events and liveness are polled."""
+
+    def __init__(self, node_addr: str, sql: str, job_id: str, parallelism: int,
+                 restore_epoch, storage_url, udf_specs):
+        from .node import _get, _post
+
+        self._get, self._post = _get, _post
+        self.node_addr = node_addr.rstrip("/")
+        r = _post(f"{self.node_addr}/start_worker", {
+            "sql": sql, "job_id": job_id, "parallelism": parallelism,
+            "restore_epoch": restore_epoch, "storage_url": storage_url,
+            "udf_specs": udf_specs,
+        })
+        self.worker_id = r["worker_id"]
+        self._alive = True
+        self._hb = time.monotonic()
+        self._buffer: list[dict] = []
+
+    def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
+        try:
+            self._post(f"{self.node_addr}/workers/{self.worker_id}/send",
+                       {"cmd": "checkpoint", "epoch": epoch, "then_stop": then_stop})
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        try:
+            self._post(f"{self.node_addr}/workers/{self.worker_id}/stop", {})
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        try:
+            self._post(f"{self.node_addr}/workers/{self.worker_id}/kill", {})
+        except OSError:
+            pass
+        self._alive = False
+
+    def poll_events(self) -> list[dict]:
+        try:
+            r = self._get(f"{self.node_addr}/workers/{self.worker_id}/events")
+        except OSError:
+            # node unreachable: let the heartbeat timeout declare death
+            return []
+        # anchor to the WORKER's own heartbeat (relayed as an age so clocks
+        # need not agree): a hung worker must still trip the controller's
+        # heartbeat timeout even though the node daemon answers polls
+        self._hb = time.monotonic() - float(r.get("hb_age_s", 0.0))
+        self._alive = bool(r["alive"]) or bool(r["events"])
+        return r["events"]
+
+    def alive(self) -> bool:
+        return self._alive
+
+    def last_heartbeat(self) -> float:
+        return self._hb
+
+
+class NodeScheduler(Scheduler):
+    """Places workers on registered node daemons (least-loaded first)."""
+
+    def __init__(self, db):
+        self.db = db
+
+    def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
+                     udf_specs=None):
+        from .node import _get
+
+        nodes = self.db.list_nodes(alive_within_s=10.0)
+        if not nodes:
+            raise RuntimeError("no live node daemons registered")
+        best, best_free = None, -1
+        for n in nodes:
+            try:
+                st = _get(f"{n['addr']}/status", timeout=5.0)
+            except OSError:
+                continue
+            free = int(st["slots"]) - int(st["used"])
+            if free > best_free:
+                best, best_free = n, free
+        if best is None or best_free < 1:
+            raise RuntimeError("no node daemon with free slots")
+        return NodeWorkerHandle(best["addr"], sql, job_id, parallelism,
+                                restore_epoch, storage_url, udf_specs)
+
+
+def scheduler_for(name: str, db=None) -> Scheduler:
     if name == "embedded":
         return EmbeddedScheduler()
     if name == "process":
         return ProcessScheduler()
-    raise ValueError(f"unknown scheduler {name!r} (have: embedded, process)")
+    if name == "node":
+        if db is None:
+            raise ValueError("node scheduler needs the shared database")
+        return NodeScheduler(db)
+    raise ValueError(f"unknown scheduler {name!r} (have: embedded, process, node)")
