@@ -1,0 +1,140 @@
+"""Typed Python client for the REST API.
+
+Equivalent of crates/arroyo-openapi (the client generated from the API's
+OpenAPI spec and used by the integration tests, integ/tests/api_tests.rs).
+One method per spec operationId; test_openapi.py asserts full coverage of
+the spec so the client cannot drift from the server.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Any, Optional
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, payload: Any):
+        super().__init__(f"HTTP {status}: {payload}")
+        self.status = status
+        self.payload = payload
+
+
+class ArroyoClient:
+    """client = ArroyoClient("http://localhost:5115")"""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- plumbing
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except Exception:
+                payload = e.reason
+            raise ApiError(e.code, payload) from None
+
+    # ----------------------------------------------------------- operations
+
+    def ping(self) -> dict:
+        return self._req("GET", "/api/v1/ping")
+
+    def validate_query(self, query: str) -> dict:
+        return self._req("POST", "/api/v1/pipelines/validate", {"query": query})
+
+    def create_pipeline(self, query: str, name: str = "pipeline",
+                        parallelism: int = 1) -> dict:
+        return self._req("POST", "/api/v1/pipelines",
+                         {"name": name, "query": query, "parallelism": parallelism})
+
+    def list_pipelines(self) -> list[dict]:
+        return self._req("GET", "/api/v1/pipelines")["data"]
+
+    def get_pipeline(self, pipeline_id: str) -> dict:
+        return self._req("GET", f"/api/v1/pipelines/{pipeline_id}")
+
+    def delete_pipeline(self, pipeline_id: str) -> dict:
+        return self._req("DELETE", f"/api/v1/pipelines/{pipeline_id}")
+
+    def pipeline_jobs(self, pipeline_id: str) -> list[dict]:
+        return self._req("GET", f"/api/v1/pipelines/{pipeline_id}/jobs")["data"]
+
+    def list_jobs(self) -> list[dict]:
+        return self._req("GET", "/api/v1/jobs")["data"]
+
+    def get_job(self, job_id: str) -> dict:
+        return self._req("GET", f"/api/v1/jobs/{job_id}")
+
+    def patch_job(self, job_id: str, stop: Optional[str] = None,
+                  parallelism: Optional[int] = None) -> dict:
+        body: dict = {}
+        if stop is not None:
+            body["stop"] = stop
+        if parallelism is not None:
+            body["parallelism"] = parallelism
+        return self._req("PATCH", f"/api/v1/jobs/{job_id}", body)
+
+    def job_checkpoints(self, job_id: str) -> dict:
+        return self._req("GET", f"/api/v1/jobs/{job_id}/checkpoints")
+
+    def job_output(self, job_id: str) -> dict:
+        return self._req("GET", f"/api/v1/jobs/{job_id}/output")
+
+    def job_metrics(self, job_id: str) -> dict:
+        return self._req("GET", f"/api/v1/jobs/{job_id}/metrics")
+
+    def list_connectors(self) -> dict:
+        return self._req("GET", "/api/v1/connectors")
+
+    def create_udf(self, name: str, source: str, language: str = "cpp",
+                   arg_dtypes: Optional[list[str]] = None,
+                   return_dtype: str = "float64") -> dict:
+        return self._req("POST", "/api/v1/udfs", {
+            "name": name, "source": source, "language": language,
+            "arg_dtypes": arg_dtypes or [], "return_dtype": return_dtype,
+        })
+
+    def list_udfs(self) -> dict:
+        return self._req("GET", "/api/v1/udfs")
+
+    def delete_udf(self, name: str) -> dict:
+        return self._req("DELETE", f"/api/v1/udfs/{urllib.parse.quote(name)}")
+
+    def register_node(self, node_id: str, addr: str, slots: int = 16) -> dict:
+        return self._req("POST", "/api/v1/nodes/register",
+                         {"node_id": node_id, "addr": addr, "slots": slots})
+
+    def node_heartbeat(self, node_id: str) -> dict:
+        return self._req("POST", f"/api/v1/nodes/{node_id}/heartbeat", {})
+
+    def list_nodes(self) -> list[dict]:
+        return self._req("GET", "/api/v1/nodes")["nodes"]
+
+    # ----------------------------------------------------------- convenience
+
+    def run_to_state(self, job_id: str, *states: str, timeout: float = 120.0):
+        """Poll until the job reaches one of ``states`` (client-side analog
+        of the integ tests' wait loops)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.get_job(job_id)
+            if job.get("state") in states:
+                return job
+            if job.get("state") == "Failed" and "Failed" not in states:
+                raise RuntimeError(f"job failed: {job.get('failure_message')}")
+            time.sleep(0.2)
+        raise TimeoutError(f"job {job_id} never reached {states}")

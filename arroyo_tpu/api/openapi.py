@@ -1,0 +1,135 @@
+"""OpenAPI 3.0 description of the REST API.
+
+Equivalent of the reference's utoipa-generated spec that feeds
+crates/arroyo-openapi (the generated client) and the web UI's typed
+bindings (webui/src/gen). The spec is built from the same route table the
+server dispatches on, so paths can't drift from the implementation; a
+test asserts the client (client.py) covers every operation.
+Served at GET /api/v1/openapi.json.
+"""
+
+from __future__ import annotations
+
+_OBJ = {"type": "object"}
+_STR = {"type": "string"}
+_INT = {"type": "integer"}
+
+
+def _op(op_id: str, summary: str, params: list[str] = (),
+        body: dict | None = None, response: dict | None = None) -> dict:
+    out: dict = {
+        "operationId": op_id,
+        "summary": summary,
+        "parameters": [
+            {"name": p, "in": "path", "required": True, "schema": _STR}
+            for p in params
+        ],
+        "responses": {
+            "200": {
+                "description": "success",
+                "content": {"application/json": {"schema": response or _OBJ}},
+            }
+        },
+    }
+    if body is not None:
+        out["requestBody"] = {
+            "required": True,
+            "content": {"application/json": {"schema": body}},
+        }
+    return out
+
+
+PIPELINE = {
+    "type": "object",
+    "properties": {"id": _STR, "name": _STR, "query": _STR, "parallelism": _INT},
+}
+JOB = {
+    "type": "object",
+    "properties": {
+        "id": _STR, "pipeline_id": _STR, "state": _STR,
+        "restarts": _INT, "checkpoint_epoch": _INT,
+    },
+}
+UDF = {
+    "type": "object",
+    "properties": {
+        "name": _STR, "language": {"type": "string", "enum": ["cpp", "python"]},
+        "source": _STR, "arg_dtypes": {"type": "array", "items": _STR},
+        "return_dtype": _STR,
+    },
+    "required": ["name", "source"],
+}
+NODE = {
+    "type": "object",
+    "properties": {"node_id": _STR, "addr": _STR, "slots": _INT},
+    "required": ["node_id", "addr"],
+}
+
+
+def spec() -> dict:
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "arroyo-tpu REST API",
+            "version": "1.0.0",
+            "description": "Pipeline/job/UDF/node management for the "
+                           "TPU-native streaming engine.",
+        },
+        "paths": {
+            "/api/v1/ping": {"get": _op("ping", "liveness probe")},
+            "/api/v1/pipelines/validate": {
+                "post": _op("validate_query", "validate SQL without creating",
+                            body={"type": "object", "properties": {"query": _STR},
+                                  "required": ["query"]})},
+            "/api/v1/pipelines": {
+                "post": _op("create_pipeline", "create pipeline + job",
+                            body={"type": "object",
+                                  "properties": {"name": _STR, "query": _STR,
+                                                 "parallelism": _INT},
+                                  "required": ["query"]}),
+                "get": _op("list_pipelines", "list pipelines",
+                           response={"type": "object",
+                                     "properties": {"data": {"type": "array",
+                                                             "items": PIPELINE}}})},
+            "/api/v1/pipelines/{pipeline_id}": {
+                "get": _op("get_pipeline", "fetch one pipeline", ["pipeline_id"],
+                           response=PIPELINE),
+                "delete": _op("delete_pipeline", "delete pipeline + jobs",
+                              ["pipeline_id"])},
+            "/api/v1/pipelines/{pipeline_id}/jobs": {
+                "get": _op("pipeline_jobs", "jobs of a pipeline", ["pipeline_id"],
+                           response={"type": "object",
+                                     "properties": {"data": {"type": "array",
+                                                             "items": JOB}}})},
+            "/api/v1/jobs": {
+                "get": _op("list_jobs", "list all jobs")},
+            "/api/v1/jobs/{job_id}": {
+                "get": _op("get_job", "fetch one job", ["job_id"], response=JOB),
+                "patch": _op("patch_job", "stop / rescale a job", ["job_id"],
+                             body={"type": "object",
+                                   "properties": {"stop": {"type": "string",
+                                                           "enum": ["checkpoint",
+                                                                    "immediate",
+                                                                    "none"]},
+                                                  "parallelism": _INT}})},
+            "/api/v1/jobs/{job_id}/checkpoints": {
+                "get": _op("job_checkpoints", "checkpoint history", ["job_id"])},
+            "/api/v1/jobs/{job_id}/output": {
+                "get": _op("job_output", "preview sink rows", ["job_id"])},
+            "/api/v1/jobs/{job_id}/metrics": {
+                "get": _op("job_metrics", "operator metric groups", ["job_id"])},
+            "/api/v1/connectors": {
+                "get": _op("list_connectors", "available connectors")},
+            "/api/v1/udfs": {
+                "post": _op("create_udf", "compile/register a UDF", body=UDF),
+                "get": _op("list_udfs", "list registered UDFs")},
+            "/api/v1/udfs/{name}": {
+                "delete": _op("delete_udf", "drop a UDF", ["name"])},
+            "/api/v1/nodes/register": {
+                "post": _op("register_node", "node daemon registration", body=NODE)},
+            "/api/v1/nodes/{node_id}/heartbeat": {
+                "post": _op("node_heartbeat", "node liveness beat", ["node_id"])},
+            "/api/v1/nodes": {
+                "get": _op("list_nodes", "registered node daemons")},
+        },
+    }

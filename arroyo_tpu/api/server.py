@@ -76,6 +76,8 @@ class ApiServer:
     # ------------------------------------------------------------- routing
 
     _ROUTES = [
+        ("GET", r"^/$", "_webui"),
+        ("GET", r"^/api/v1/openapi\.json$", "_openapi"),
         ("GET", r"^/api/v1/ping$", "_ping"),
         ("POST", r"^/api/v1/pipelines/validate$", "_validate"),
         ("POST", r"^/api/v1/pipelines$", "_create_pipeline"),
@@ -116,6 +118,24 @@ class ApiServer:
 
     def _ping(self, h):
         h._json(200, {"pong": True})
+
+    def _openapi(self, h):
+        from .openapi import spec
+
+        h._json(200, spec())
+
+    def _webui(self, h):
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "webui", "index.html")
+        with open(path, "rb") as f:
+            data = f.read()
+        h.send_response(200)
+        h.send_header("Content-Type", "text/html; charset=utf-8")
+        h.send_header("Content-Length", str(len(data)))
+        h.end_headers()
+        h.wfile.write(data)
 
     def _activate_udfs(self) -> None:
         from ..compiler import activate_udf_specs
@@ -175,15 +195,19 @@ class ApiServer:
             if language == "cpp":
                 spec = CompileService().build_udf(name, source, arg_dtypes, return_dtype)
                 artifact = spec.artifact_url
-            # activate FIRST: a source that fails to compile/exec must never
-            # be persisted, or it would poison every later validate/create
-            activate_udf_specs([{
-                "name": name, "language": language, "source": source,
-                "arg_dtypes": arg_dtypes, "return_dtype": return_dtype,
-                "artifact_url": artifact,
-            }])
             self.db.create_udf(name, language, source, arg_dtypes, return_dtype, artifact)
-        except (CompileError, Exception) as e:  # noqa: B014 - user code raises anything
+            try:
+                # a source that fails to activate must not stay persisted, or
+                # it would poison every later validate/create
+                activate_udf_specs([{
+                    "name": name, "language": language, "source": source,
+                    "arg_dtypes": arg_dtypes, "return_dtype": return_dtype,
+                    "artifact_url": artifact,
+                }])
+            except Exception:
+                self.db.delete_udf(name)
+                raise
+        except Exception as e:  # user code raises anything
             h._json(400, {"error": f"UDF rejected: {e}"})
             return
         h._json(200, {"name": name, "language": language, "artifact_url": artifact})

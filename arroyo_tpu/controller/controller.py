@@ -168,6 +168,12 @@ class JobController:
                 else:
                     self._set_state(JobState.FINISHING)
                     self._set_state(JobState.FINISHED)
+                # release the exited worker's resources (temp sql/udf files,
+                # pipes); for a finished process this is pure cleanup
+                try:
+                    self.handle.kill()
+                except Exception:
+                    pass
                 self.handle = None
                 return
             elif kind == "failed":

@@ -113,6 +113,14 @@ class NodeServer:
 
         body = h._body()
         wid = f"worker_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            used = sum(1 for w in self._workers.values() if w.alive())
+            if used >= self.slots:
+                # slots are a hard admission limit, not advisory — the
+                # scheduler's status poll races concurrent placements
+                h._json(409, {"error": f"node full ({used}/{self.slots} slots)"})
+                return
+            self._workers[wid] = None  # reserve under the lock
         handle = ProcessWorkerHandle(
             body["sql"], body["job_id"], int(body.get("parallelism", 1)),
             body.get("restore_epoch"), body.get("storage_url"),
@@ -124,7 +132,7 @@ class NodeServer:
 
     def _handle(self, wid: str):
         with self._lock:
-            return self._workers.get(wid)
+            return self._workers.get(wid)  # None while still being spawned
 
     def _stop_worker(self, h, wid) -> None:
         handle = self._handle(wid)
@@ -159,17 +167,26 @@ class NodeServer:
         if handle is None:
             h._json(404, {"error": "unknown worker"})
             return
+        events = handle.poll_events()
+        alive = handle.alive()
         h._json(200, {
-            "events": handle.poll_events(),
-            "alive": handle.alive(),
+            "events": events,
+            "alive": alive,
             # real worker liveness, not node-daemon reachability: the
             # controller's hang detection needs the worker's own heartbeat
             "hb_age_s": time.monotonic() - handle.last_heartbeat(),
         })
+        if not alive and not events:
+            # exited and fully drained: reap (kill() on a dead process only
+            # releases pipes and the temp sql/udf files)
+            handle.kill()
+            with self._lock:
+                self._workers.pop(wid, None)
 
     def _status(self, h) -> None:
         with self._lock:
-            used = sum(1 for w in self._workers.values() if w.alive())
+            used = sum(1 for w in self._workers.values()
+                       if w is None or w.alive())
         h._json(200, {"node_id": self.node_id, "slots": self.slots, "used": used})
 
     # ------------------------------------------------------------ lifecycle

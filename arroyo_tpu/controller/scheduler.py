@@ -324,25 +324,40 @@ class NodeScheduler(Scheduler):
         self.db = db
 
     def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
-                     udf_specs=None):
+                     udf_specs=None, placement_timeout_s: float = 30.0):
+        import urllib.error
+
         from .node import _get
 
-        nodes = self.db.list_nodes(alive_within_s=10.0)
-        if not nodes:
-            raise RuntimeError("no live node daemons registered")
-        best, best_free = None, -1
-        for n in nodes:
-            try:
-                st = _get(f"{n['addr']}/status", timeout=5.0)
-            except OSError:
-                continue
-            free = int(st["slots"]) - int(st["used"])
-            if free > best_free:
-                best, best_free = n, free
-        if best is None or best_free < 1:
-            raise RuntimeError("no node daemon with free slots")
-        return NodeWorkerHandle(best["addr"], sql, job_id, parallelism,
-                                restore_epoch, storage_url, udf_specs)
+        # a node daemon mid-restart or a briefly-full cluster is a transient
+        # condition: retry placement for a bounded window instead of letting
+        # the job fail terminally (reference Scheduling waits for workers)
+        deadline = time.monotonic() + placement_timeout_s
+        last = "no live node daemons registered"
+        while time.monotonic() < deadline:
+            nodes = self.db.list_nodes(alive_within_s=10.0)
+            candidates = []
+            for n in nodes:
+                try:
+                    st = _get(f"{n['addr']}/status", timeout=5.0)
+                except OSError:
+                    continue
+                free = int(st["slots"]) - int(st["used"])
+                if free >= 1:
+                    candidates.append((free, n))
+            candidates.sort(key=lambda fn: -fn[0])
+            for _free, n in candidates:
+                try:
+                    return NodeWorkerHandle(n["addr"], sql, job_id, parallelism,
+                                            restore_epoch, storage_url, udf_specs)
+                except urllib.error.HTTPError as e:
+                    last = f"node {n['id']} rejected placement: {e}"
+                except OSError as e:
+                    last = f"node {n['id']} unreachable: {e}"
+            if nodes:
+                last = "no node daemon with free slots"
+            time.sleep(0.5)
+        raise RuntimeError(last)
 
 
 def scheduler_for(name: str, db=None) -> Scheduler:
