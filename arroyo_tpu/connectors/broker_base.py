@@ -1,0 +1,75 @@
+"""Shared poll loop for broker-style sources (NATS, MQTT).
+
+One implementation of the control/checkpoint/flush cycle the reference
+repeats per broker connector: poll control (checkpoint/stop), pull one
+message from the client, feed the deserializer, flush on batch boundaries
+and idle timeouts, and send a keepalive when the link has been quiet.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Callable, Optional
+
+from ..types import SourceFinishType
+
+
+def run_broker_source(
+    sctx,
+    collector,
+    cfg: dict,
+    schema,
+    next_message: Callable[[], Optional[bytes]],
+    close: Callable[[], None],
+    keepalive: Optional[Callable[[], None]] = None,
+    keepalive_interval_s: float = 20.0,
+) -> SourceFinishType:
+    """next_message(): one payload or None (non-message protocol op);
+    raises socket.timeout when idle and ConnectionError when the broker is
+    gone (treated as end-of-stream, matching the reference's non-replayable
+    broker sources)."""
+    from ..formats.registry import make_deserializer
+
+    de = make_deserializer(cfg, schema)
+    last_io = time.monotonic()
+
+    def flush():
+        b = de.flush()
+        if b is not None:
+            collector.collect(b)
+
+    while True:
+        msg = sctx.poll_control()
+        if msg is not None:
+            if msg.kind == "checkpoint":
+                flush()
+                sctx.start_checkpoint(msg.barrier)
+                if msg.barrier.then_stop:
+                    close()
+                    return SourceFinishType.FINAL
+            elif msg.kind == "stop":
+                close()
+                return SourceFinishType.IMMEDIATE
+        try:
+            payload = next_message()
+        except (TimeoutError, socket.timeout):
+            if de.should_flush():
+                flush()
+            if keepalive is not None and time.monotonic() - last_io > keepalive_interval_s:
+                try:
+                    keepalive()
+                except OSError:
+                    flush()
+                    return SourceFinishType.GRACEFUL
+                last_io = time.monotonic()
+            continue
+        except ConnectionError:
+            flush()
+            return SourceFinishType.GRACEFUL
+        last_io = time.monotonic()
+        if payload is None:
+            continue
+        de.deserialize(payload, timestamp_micros=int(time.time() * 1e6))
+        if de.should_flush():
+            flush()

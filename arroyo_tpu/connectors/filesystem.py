@@ -18,6 +18,8 @@ from __future__ import annotations
 import glob as _glob
 import json
 import os
+import time
+import uuid
 from typing import Optional
 
 from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Schema
@@ -253,5 +255,131 @@ class FileSystemSink(Operator):
         raise ValueError(f"filesystem sink: unknown format {self.fmt!r}")
 
 
+_DELTA_TYPES = {
+    "int64": "long", "int32": "integer", "uint64": "long",
+    "float64": "double", "float32": "float", "bool": "boolean",
+    "string": "string", "timestamp": "timestamp",
+}
+
+
+class DeltaSink(FileSystemSink):
+    """Delta Lake table writer (reference:
+    crates/arroyo-connectors/src/filesystem/delta.rs — parquet parts plus
+    Delta transaction-log commits). Parts land through the same two-phase
+    commit as the filesystem sink; each committed epoch then appends one
+    version to ``_delta_log`` with its ``add`` actions (version 0 also
+    carries ``protocol`` and ``metaData``). Versions are claimed atomically
+    with O_EXCL creates, so parallel subtasks committing the same epoch
+    serialize instead of clobbering; re-commits after a crash rewrite the
+    same deterministic part names, and duplicate ``add`` actions for an
+    identical path are a no-op to Delta readers (last action wins)."""
+
+    def __init__(self, cfg: dict):
+        cfg = dict(cfg)
+        cfg["format"] = "parquet"
+        super().__init__(cfg)
+        if self.schema is None:
+            raise ValueError("delta sink requires a schema")
+
+    def _write_rows(self, path: str, rows: list[dict]) -> None:
+        # parquet with proper logical types: Delta declares "timestamp"
+        # columns in its schemaString, so the parquet column must carry a
+        # timestamp logical type, not raw int64 micros
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        drop = {TIMESTAMP_FIELD, KEY_FIELD}
+        clean = [{k: v for k, v in r.items() if k not in drop} for r in rows]
+        ts_fields = {f.name for f in self.schema.fields if f.dtype == "timestamp"}
+        names = list(clean[0].keys()) if clean else []
+        arrays = []
+        for n in names:
+            vals = [r.get(n) for r in clean]
+            if n in ts_fields:
+                arrays.append(pa.array(
+                    [None if v is None else int(v) for v in vals],
+                    type=pa.timestamp("us"),
+                ))
+            else:
+                arrays.append(pa.array(vals))
+        pq.write_table(pa.table(arrays, names=names), path)
+
+    def _schema_string(self) -> str:
+        fields = [
+            {"name": f.name, "type": _DELTA_TYPES.get(f.dtype, "string"),
+             "nullable": True, "metadata": {}}
+            for f in self.schema.fields
+            if f.name not in (TIMESTAMP_FIELD, KEY_FIELD)
+        ]
+        return json.dumps({"type": "struct", "fields": fields})
+
+    def _write_epoch(self, ctx, epoch: int) -> None:
+        groups = self.pending_commit.pop(epoch, None)
+        if not groups:
+            return
+        sub = ctx.task_info.subtask_index
+        adds = []
+        now_ms = int(time.time() * 1000)
+        for key, rows in groups.items():
+            d = self._partition_dir(key)
+            os.makedirs(d, exist_ok=True)
+            final = os.path.join(d, f"part-{sub:03d}-{epoch:07d}.parquet")
+            tmp = final + ".tmp"
+            self._write_rows(tmp, rows)
+            os.replace(tmp, final)
+            rel = os.path.relpath(final, self.dir)
+            adds.append({"add": {
+                "path": rel.replace(os.sep, "/"),
+                "partitionValues": {
+                    f: str(v) for f, v in zip(self.partition_fields, key)
+                },
+                "size": os.path.getsize(final),
+                "modificationTime": now_ms,
+                "dataChange": True,
+            }})
+        self._commit_log(adds, now_ms)
+
+    def _commit_log(self, actions: list[dict], now_ms: int) -> None:
+        log_dir = os.path.join(self.dir, "_delta_log")
+        os.makedirs(log_dir, exist_ok=True)
+        while True:
+            versions = [
+                int(fn.split(".")[0]) for fn in os.listdir(log_dir)
+                if fn.endswith(".json") and fn.split(".")[0].isdigit()
+            ]
+            v = (max(versions) + 1) if versions else 0
+            entry = list(actions)
+            if v == 0:
+                entry = [
+                    {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
+                    {"metaData": {
+                        "id": uuid.uuid4().hex,
+                        "format": {"provider": "parquet", "options": {}},
+                        "schemaString": self._schema_string(),
+                        "partitionColumns": list(self.partition_fields),
+                        "configuration": {},
+                        "createdTime": now_ms,
+                    }},
+                ] + entry
+            path = os.path.join(log_dir, f"{v:020d}.json")
+            # atomic publish: fully write a tmp file, then claim the version
+            # with a hard link (fails if another subtask won) — a crash can
+            # never leave a truncated version in the log
+            tmp = os.path.join(log_dir, f".{uuid.uuid4().hex}.tmp")
+            with open(tmp, "w") as f:
+                for a in entry:
+                    f.write(json.dumps(a, separators=(",", ":")) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                os.unlink(tmp)
+                continue  # another subtask claimed this version; retry
+            os.unlink(tmp)
+            return
+
+
 register_source("filesystem")(FileSystemSource)
 register_sink("filesystem")(FileSystemSink)
+register_sink("delta")(DeltaSink)

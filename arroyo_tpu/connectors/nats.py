@@ -1,0 +1,218 @@
+"""NATS connector: source + sink over a from-scratch client.
+
+Reference: crates/arroyo-connectors/src/nats (core-NATS subject source and
+sink via async-nats). Core NATS is a line-oriented text protocol (INFO/
+CONNECT/SUB/PUB/MSG/PING/PONG), spoken here directly over a socket — no
+client library, keeping the connector dependency-free for the air-gapped
+image (same approach as the websocket/redis connectors).
+
+Delivery notes, mirroring the reference: core NATS is at-most-once fan-out
+with no replay, so the source checkpoints no offsets (a restore resumes
+from "now", exactly like the reference's non-JetStream path) and the sink
+is fire-and-forget per row.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+from typing import Optional
+
+from ..batch import Schema
+from ..operators.base import Operator, SourceOperator, TableSpec
+from ..types import SourceFinishType
+from . import register_sink, register_source
+
+
+class NatsClient:
+    """Minimal core-NATS client: connect, subscribe, publish, read MSGs."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 4222,
+                 timeout: float = 10.0, name: str = "arroyo-tpu"):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+        info = self._read_line()  # server greeting
+        if not info.startswith(b"INFO "):
+            raise ConnectionError(f"not a NATS server: {info[:64]!r}")
+        self.server_info = json.loads(info[5:])
+        self.sock.sendall(
+            b"CONNECT " + json.dumps({
+                "verbose": False, "pedantic": False, "name": name,
+                "lang": "python", "version": "1.0.0", "protocol": 0,
+            }).encode() + b"\r\nPING\r\n"
+        )
+        # drain until PONG so connect errors surface here
+        while True:
+            line = self._read_line()
+            if line == b"PONG":
+                break
+            if line.startswith(b"-ERR"):
+                raise ConnectionError(f"NATS connect rejected: {line.decode()}")
+
+    def _fill(self) -> None:
+        chunk = self.sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("NATS connection closed")
+        self.buf += chunk
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            self._fill()
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _peek_line(self) -> Optional[bytes]:
+        """Complete line without consuming (so a timeout mid-message never
+        loses already-buffered protocol bytes)."""
+        if b"\r\n" not in self.buf:
+            return None
+        return self.buf.split(b"\r\n", 1)[0]
+
+    def subscribe(self, subject: str, sid: str = "1",
+                  queue_group: Optional[str] = None) -> None:
+        q = f" {queue_group}" if queue_group else ""
+        self.sock.sendall(f"SUB {subject}{q} {sid}\r\n".encode())
+
+    def publish(self, subject: str, payload: bytes) -> None:
+        self.sock.sendall(
+            f"PUB {subject} {len(payload)}\r\n".encode() + payload + b"\r\n"
+        )
+
+    def next_msg(self) -> Optional[tuple[str, bytes]]:
+        """One protocol op; (subject, payload) for MSG, None otherwise.
+        Raises socket.timeout when idle (caller polls control then). The
+        buffer is only consumed once a whole op is present, so a timeout
+        mid-frame never desyncs the stream."""
+        while True:
+            line = self._peek_line()
+            if line is None:
+                self._fill()  # raises socket.timeout when idle
+                continue
+            if line.startswith(b"MSG "):
+                parts = line.decode().split(" ")
+                # MSG <subject> <sid> [reply-to] <#bytes>
+                n = int(parts[-1])
+                need = len(line) + 2 + n + 2
+                if len(self.buf) < need:
+                    self._fill()
+                    continue
+                payload = self.buf[len(line) + 2 : len(line) + 2 + n]
+                self.buf = self.buf[need:]
+                return parts[1], payload
+            # non-MSG op: consume the line
+            self.buf = self.buf[len(line) + 2:]
+            if line == b"PING":
+                self.sock.sendall(b"PONG\r\n")
+            elif line.startswith(b"-ERR"):
+                raise ConnectionError(f"NATS error: {line.decode()}")
+            return None
+
+    def ping(self) -> None:
+        self.sock.sendall(b"PING\r\n")
+
+    def drain_server_ops(self) -> None:
+        """Answer pending server PINGs / surface -ERR without blocking —
+        write-mostly users (the sink) must still service the link or the
+        server declares the connection stale."""
+        self.sock.settimeout(0.0)
+        try:
+            while True:
+                line = self._peek_line()
+                if line is None:
+                    try:
+                        self._fill()
+                    except (BlockingIOError, TimeoutError, socket.timeout):
+                        return
+                    continue
+                if line.startswith(b"MSG "):
+                    return  # subscriber data is the reader loop's business
+                self.buf = self.buf[len(line) + 2:]
+                if line == b"PING":
+                    self.sock.sendall(b"PONG\r\n")
+                elif line.startswith(b"-ERR"):
+                    raise ConnectionError(f"NATS error: {line.decode()}")
+        finally:
+            self.sock.settimeout(None)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _parse_servers(cfg: dict) -> tuple[str, int]:
+    servers = cfg.get("servers", "nats://127.0.0.1:4222")
+    first = servers.split(",")[0].strip()
+    if "://" in first:
+        first = first.split("://", 1)[1]
+    host, _, port = first.partition(":")
+    return host or "127.0.0.1", int(port or 4222)
+
+
+class NatsSource(SourceOperator):
+    """config: servers ("nats://host:port[,...]"), subject, queue_group
+    (optional — NATS-side load balancing across parallel subtasks),
+    schema + format options."""
+
+    def __init__(self, cfg: dict):
+        self.cfg = cfg
+        self.schema: Schema = cfg["schema"]
+        self.subject = str(cfg["subject"])
+        self.queue_group = cfg.get("queue_group")
+
+    def tables(self):
+        return [TableSpec("s", "global_keyed")]
+
+    def run(self, sctx, collector) -> SourceFinishType:
+        ctx = sctx.ctx
+        if ctx.task_info.subtask_index != 0 and not self.queue_group:
+            # without a queue group every subscriber sees every message;
+            # one subtask reads to avoid duplicates (reference does the same
+            # for non-queue subscriptions)
+            return SourceFinishType.GRACEFUL
+        host, port = _parse_servers(self.cfg)
+        client = NatsClient(host, port)
+        client.subscribe(self.subject,
+                         sid=str(ctx.task_info.subtask_index + 1),
+                         queue_group=self.queue_group)
+        client.sock.settimeout(0.2)
+        from .broker_base import run_broker_source
+
+        def next_message():
+            got = client.next_msg()
+            return None if got is None else got[1]
+
+        return run_broker_source(sctx, collector, self.cfg, self.schema,
+                                 next_message, client.close,
+                                 keepalive=client.ping)
+
+
+class NatsSink(Operator):
+    """config: servers, subject, schema + format options."""
+
+    def __init__(self, cfg: dict):
+        self.cfg = cfg
+        self.subject = str(cfg["subject"])
+        self.client: Optional[NatsClient] = None
+
+    def on_start(self, ctx):
+        host, port = _parse_servers(self.cfg)
+        self.client = NatsClient(host, port)
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        from ..formats.registry import serialize_batch
+
+        assert self.client is not None
+        self.client.drain_server_ops()  # answer PINGs, surface -ERR
+        for payload in serialize_batch(self.cfg, batch, self.cfg.get("schema")):
+            self.client.publish(self.subject, payload)
+
+    def on_close(self, ctx, collector):
+        if self.client is not None:
+            self.client.close()
+
+
+register_source("nats")(NatsSource)
+register_sink("nats")(NatsSink)

@@ -1,8 +1,9 @@
 """Gated connectors: broker integrations that need client libraries not in
-the air-gapped image (reference has kinesis, fluvio, mqtt, nats, rabbitmq —
-arroyo-connectors §2.9). Each registers under its name with its config
-surface documented; constructing one without its client package raises with
-install instructions, matching how the kafka connector degrades.
+the air-gapped image (reference arroyo-connectors §2.9). mqtt and nats have
+from-scratch protocol implementations (mqtt.py / nats.py); the remainder
+register here with their config surface documented, and constructing one
+without its client package raises with install instructions, matching how
+the kafka connector degrades.
 """
 
 from __future__ import annotations
@@ -18,16 +19,6 @@ _SPECS = {
     "fluvio": {
         "package": "fluvio",
         "options": ["endpoint", "topic"],
-        "kinds": ("source", "sink"),
-    },
-    "mqtt": {
-        "package": "paho-mqtt",
-        "options": ["url", "topic", "qos", "username", "password"],
-        "kinds": ("source", "sink"),
-    },
-    "nats": {
-        "package": "nats-py",
-        "options": ["servers", "subject", "consumer.*"],
         "kinds": ("source", "sink"),
     },
     "rabbitmq": {
