@@ -136,13 +136,24 @@ def _cmd_worker(args) -> int:
 
         with open(args.udfs_file) as f:
             activate_udf_specs(json.load(f))
-    with open(args.sql_file) as f:
-        sql = f.read()
-    pp = plan_query(sql)
-    if args.parallelism > 1:
-        set_parallelism(pp.graph, args.parallelism)
+    if not getattr(args, "graph_file", None) and not getattr(args, "sql_file", None):
+        print("worker: one of --sql-file / --graph-file is required", file=sys.stderr)
+        return 2
+    if getattr(args, "graph_file", None):
+        # pre-planned IR shipped by the control plane: no local re-planning
+        from arroyo_tpu.graph import Graph
+
+        with open(args.graph_file) as f:
+            graph = Graph.loads(f.read())
+    else:
+        with open(args.sql_file) as f:
+            sql = f.read()
+        pp = plan_query(sql)
+        if args.parallelism > 1:
+            set_parallelism(pp.graph, args.parallelism)
+        graph = pp.graph
     eng = Engine(
-        pp.graph, job_id=args.job_id,
+        graph, job_id=args.job_id,
         restore_epoch=args.restore_epoch,
         storage_url=args.storage_url or None,
     )
@@ -250,7 +261,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.set_defaults(fn=_cmd_api)
 
     wp = sub.add_parser("worker", help="worker subprocess (used by process scheduler)")
-    wp.add_argument("--sql-file", required=True)
+    wp.add_argument("--sql-file", default=None)
+    wp.add_argument("--graph-file", default=None)
     wp.add_argument("--job-id", required=True)
     wp.add_argument("--parallelism", type=int, default=1)
     wp.add_argument("--restore-epoch", type=int, default=None)

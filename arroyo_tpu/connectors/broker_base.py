@@ -32,7 +32,7 @@ def run_broker_source(
     from ..formats.registry import make_deserializer
 
     de = make_deserializer(cfg, schema)
-    last_io = time.monotonic()
+    last_sent = time.monotonic()
 
     def flush():
         b = de.flush()
@@ -40,6 +40,16 @@ def run_broker_source(
             collector.collect(b)
 
     while True:
+        # keepalive is a CLIENT-to-server obligation (MQTT-3.1.2-24): inbound
+        # traffic does not reset the broker's timer, so ping on cadence
+        # regardless of how busy the subscription is
+        if keepalive is not None and time.monotonic() - last_sent > keepalive_interval_s:
+            try:
+                keepalive()
+            except OSError:
+                flush()
+                return SourceFinishType.GRACEFUL
+            last_sent = time.monotonic()
         msg = sctx.poll_control()
         if msg is not None:
             if msg.kind == "checkpoint":
@@ -56,18 +66,10 @@ def run_broker_source(
         except (TimeoutError, socket.timeout):
             if de.should_flush():
                 flush()
-            if keepalive is not None and time.monotonic() - last_io > keepalive_interval_s:
-                try:
-                    keepalive()
-                except OSError:
-                    flush()
-                    return SourceFinishType.GRACEFUL
-                last_io = time.monotonic()
             continue
         except ConnectionError:
             flush()
             return SourceFinishType.GRACEFUL
-        last_io = time.monotonic()
         if payload is None:
             continue
         de.deserialize(payload, timestamp_micros=int(time.time() * 1e6))

@@ -243,6 +243,7 @@ class MqttSink(Operator):
         """Answer broker PINGREQs between batches without blocking (idle
         sinks must keep the keepalive contract too)."""
         assert self.client is not None
+        old = self.client.sock.gettimeout()
         self.client.sock.settimeout(0.0)
         try:
             while True:
@@ -257,7 +258,7 @@ class MqttSink(Operator):
                 if ptype == PINGREQ:
                     self.client._send(PINGRESP, 0, b"")
         finally:
-            self.client.sock.settimeout(None)
+            self.client.sock.settimeout(old)
 
     def handle_tick(self, ctx, collector):
         if self.client is not None:

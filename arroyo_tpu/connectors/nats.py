@@ -115,6 +115,7 @@ class NatsClient:
         """Answer pending server PINGs / surface -ERR without blocking —
         write-mostly users (the sink) must still service the link or the
         server declares the connection stale."""
+        old = self.sock.gettimeout()
         self.sock.settimeout(0.0)
         try:
             while True:
@@ -133,7 +134,7 @@ class NatsClient:
                 elif line.startswith(b"-ERR"):
                     raise ConnectionError(f"NATS error: {line.decode()}")
         finally:
-            self.sock.settimeout(None)
+            self.sock.settimeout(old)
 
     def close(self) -> None:
         try:
@@ -208,6 +209,16 @@ class NatsSink(Operator):
         self.client.drain_server_ops()  # answer PINGs, surface -ERR
         for payload in serialize_batch(self.cfg, batch, self.cfg.get("schema")):
             self.client.publish(self.subject, payload)
+
+    def handle_tick(self, ctx, collector):
+        # idle sinks must keep the link serviced too, or the server declares
+        # it stale after unanswered PINGs
+        if self.client is not None:
+            self.client.ping()
+            self.client.drain_server_ops()
+
+    def tick_interval_micros(self):
+        return 20_000_000
 
     def on_close(self, ctx, collector):
         if self.client is not None:

@@ -111,6 +111,27 @@ class JobController:
         plan_query(self.sql)  # validate; workers re-plan themselves
         self._set_state(JobState.SCHEDULING)
 
+    def _compile_graph(self):
+        """Plan once in the control plane and ship the dataflow IR to
+        workers as data (reference: the API compiles SQL to a protobuf
+        ArrowProgram and StartExecutionReq carries it — workers never
+        re-plan). Falls back to shipping SQL when a config carries live
+        objects the IR cannot serialize (e.g. in-process lookup tables)."""
+        try:
+            from ..sql import plan_query
+            from ..sql.planner import set_parallelism
+
+            pp = plan_query(self.sql)
+            if self.parallelism > 1:
+                set_parallelism(pp.graph, self.parallelism)
+            dumped = pp.graph.dumps()
+            from ..graph import Graph
+
+            Graph.loads(dumped)  # round-trip check before shipping
+            return dumped
+        except Exception:
+            return None
+
     def _schedule(self, job: dict) -> None:
         if self.sql is None:
             # a fresh JobController adopting a Restarting/Recovering job
@@ -125,6 +146,7 @@ class JobController:
         self.handle = self.scheduler.start_worker(
             self.sql, self.job_id, self.parallelism, self.restore_epoch,
             self.storage_url, udf_specs=self.db.list_udfs(),
+            graph_json=self._compile_graph(),
         )
         self.running_since = time.monotonic()
         self.last_checkpoint_time = time.monotonic()

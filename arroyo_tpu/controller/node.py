@@ -124,7 +124,7 @@ class NodeServer:
         handle = ProcessWorkerHandle(
             body["sql"], body["job_id"], int(body.get("parallelism", 1)),
             body.get("restore_epoch"), body.get("storage_url"),
-            body.get("udf_specs"),
+            body.get("udf_specs"), body.get("graph_json"),
         )
         with self._lock:
             self._workers[wid] = handle

@@ -59,15 +59,23 @@ class EmbeddedWorkerHandle(WorkerHandle):
     (reference schedulers/embedded.rs)."""
 
     def __init__(self, sql: str, job_id: str, parallelism: int,
-                 restore_epoch: Optional[int], storage_url: Optional[str] = None):
+                 restore_epoch: Optional[int], storage_url: Optional[str] = None,
+                 graph_json: Optional[str] = None):
         from ..engine.engine import Engine
-        from ..sql import plan_query
-        from ..sql.planner import set_parallelism
 
-        pp = plan_query(sql)
-        if parallelism > 1:
-            set_parallelism(pp.graph, parallelism)
-        self.engine = Engine(pp.graph, job_id=job_id, restore_epoch=restore_epoch,
+        if graph_json is not None:
+            from ..graph import Graph
+
+            graph = Graph.loads(graph_json)  # pre-planned, pre-parallelized IR
+        else:
+            from ..sql import plan_query
+            from ..sql.planner import set_parallelism
+
+            pp = plan_query(sql)
+            if parallelism > 1:
+                set_parallelism(pp.graph, parallelism)
+            graph = pp.graph
+        self.engine = Engine(graph, job_id=job_id, restore_epoch=restore_epoch,
                              storage_url=storage_url)
         self._events: "queue.Queue[dict]" = queue.Queue()
         self._reported_epochs: set[int] = set()
@@ -136,17 +144,24 @@ class ProcessWorkerHandle(WorkerHandle):
 
     def __init__(self, sql: str, job_id: str, parallelism: int,
                  restore_epoch: Optional[int], storage_url: Optional[str] = None,
-                 udf_specs: Optional[list] = None):
+                 udf_specs: Optional[list] = None, graph_json: Optional[str] = None):
         import tempfile
 
-        self._sql_file = tempfile.NamedTemporaryFile(
-            "w", suffix=".sql", prefix=f"{job_id}-", delete=False
+        # the planned IR ships as data when serializable (reference:
+        # StartExecutionReq carries the protobuf program); SQL remains the
+        # fallback for graphs holding live objects
+        suffix, payload, flag = (
+            (".graph.json", graph_json, "--graph-file") if graph_json is not None
+            else (".sql", sql, "--sql-file")
         )
-        self._sql_file.write(sql)
+        self._sql_file = tempfile.NamedTemporaryFile(
+            "w", suffix=suffix, prefix=f"{job_id}-", delete=False
+        )
+        self._sql_file.write(payload)
         self._sql_file.close()
         cmd = [
             sys.executable, "-m", "arroyo_tpu", "worker",
-            "--sql-file", self._sql_file.name,
+            flag, self._sql_file.name,
             "--job-id", job_id,
             "--parallelism", str(parallelism),
         ]
@@ -235,25 +250,27 @@ class Scheduler:
     def start_worker(self, sql: str, job_id: str, parallelism: int,
                      restore_epoch: Optional[int],
                      storage_url: Optional[str] = None,
-                     udf_specs: Optional[list] = None) -> WorkerHandle:
+                     udf_specs: Optional[list] = None,
+                     graph_json: Optional[str] = None) -> WorkerHandle:
         raise NotImplementedError
 
 
 class EmbeddedScheduler(Scheduler):
     def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
-                     udf_specs=None):
+                     udf_specs=None, graph_json=None):
         if udf_specs:
             from ..compiler import activate_udf_specs
 
             activate_udf_specs(udf_specs)
-        return EmbeddedWorkerHandle(sql, job_id, parallelism, restore_epoch, storage_url)
+        return EmbeddedWorkerHandle(sql, job_id, parallelism, restore_epoch, storage_url,
+                                    graph_json)
 
 
 class ProcessScheduler(Scheduler):
     def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
-                     udf_specs=None):
+                     udf_specs=None, graph_json=None):
         return ProcessWorkerHandle(sql, job_id, parallelism, restore_epoch, storage_url,
-                                   udf_specs)
+                                   udf_specs, graph_json)
 
 
 class NodeWorkerHandle(WorkerHandle):
@@ -262,7 +279,7 @@ class NodeWorkerHandle(WorkerHandle):
     over the node's HTTP surface; events and liveness are polled."""
 
     def __init__(self, node_addr: str, sql: str, job_id: str, parallelism: int,
-                 restore_epoch, storage_url, udf_specs):
+                 restore_epoch, storage_url, udf_specs, graph_json=None):
         from .node import _get, _post
 
         self._get, self._post = _get, _post
@@ -270,7 +287,7 @@ class NodeWorkerHandle(WorkerHandle):
         r = _post(f"{self.node_addr}/start_worker", {
             "sql": sql, "job_id": job_id, "parallelism": parallelism,
             "restore_epoch": restore_epoch, "storage_url": storage_url,
-            "udf_specs": udf_specs,
+            "udf_specs": udf_specs, "graph_json": graph_json,
         })
         self.worker_id = r["worker_id"]
         self._alive = True
@@ -324,7 +341,8 @@ class NodeScheduler(Scheduler):
         self.db = db
 
     def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
-                     udf_specs=None, placement_timeout_s: float = 30.0):
+                     udf_specs=None, graph_json=None,
+                     placement_timeout_s: float = 30.0):
         import urllib.error
 
         from .node import _get
@@ -349,7 +367,8 @@ class NodeScheduler(Scheduler):
             for _free, n in candidates:
                 try:
                     return NodeWorkerHandle(n["addr"], sql, job_id, parallelism,
-                                            restore_epoch, storage_url, udf_specs)
+                                            restore_epoch, storage_url, udf_specs,
+                                            graph_json)
                 except urllib.error.HTTPError as e:
                     last = f"node {n['id']} rejected placement: {e}"
                 except OSError as e:

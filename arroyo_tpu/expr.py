@@ -411,3 +411,67 @@ def eval_expr(expr: Expr, batch_cols: dict[str, np.ndarray], n: int) -> np.ndarr
             return out
         return np.full(n, v)
     return np.asarray(v)
+
+
+# ---------------------------------------------------------------- serde
+#
+# Expression ASTs serialize to tagged JSON so dataflow graphs can cross
+# process boundaries as data (the reference ships protobuf-encoded physical
+# plans, api.proto:30-110; this is the same idea over the repo's own AST).
+# Python UDF expressions serialize by NAME and re-resolve against the
+# registry on load — the function itself never crosses the wire.
+
+import dataclasses as _dc
+
+
+def _expr_registry() -> dict:
+    reg = {c.__name__: c for c in (Col, Lit, BinOp, Not, Neg, Cast, Case, Func)}
+    from .udf import UdfExpr
+
+    reg["UdfExpr"] = UdfExpr
+    return reg
+
+
+def _ser(v):
+    if isinstance(v, Expr):
+        return expr_to_json(v)
+    if isinstance(v, (list, tuple)):
+        return [_ser(x) for x in v]
+    return v
+
+
+def _deser(v):
+    if isinstance(v, dict) and "__e__" in v:
+        return expr_from_json(v)
+    if isinstance(v, list):
+        return tuple(_deser(x) for x in v)
+    return v
+
+
+def expr_to_json(e: Expr) -> dict:
+    from .udf import UdfExpr
+
+    if isinstance(e, UdfExpr):
+        # by-name: fn/vectorized/return_dtype re-resolve from the registry
+        return {"__e__": "UdfExpr", "udf_name": e.udf_name,
+                "args": [_ser(a) for a in e.args]}
+    out = {"__e__": type(e).__name__}
+    for f in _dc.fields(e):
+        out[f.name] = _ser(getattr(e, f.name))
+    return out
+
+
+def expr_from_json(d: dict) -> Expr:
+    kind = d["__e__"]
+    if kind == "UdfExpr":
+        from .udf import lookup_udf
+
+        u = lookup_udf(d["udf_name"])
+        if u is None:
+            raise ValueError(
+                f"expression references unregistered UDF {d['udf_name']!r}"
+            )
+        return u.as_expr(tuple(_deser(a) for a in d["args"]))
+    cls = _expr_registry()[kind]
+    kwargs = {k: _deser(v) for k, v in d.items() if k != "__e__"}
+    return cls(**kwargs)

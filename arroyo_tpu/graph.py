@@ -142,7 +142,8 @@ class Graph:
         g = Graph()
         for nd in d["nodes"]:
             g.add_node(
-                Node(nd["node_id"], OpName(nd["op"]), nd["config"], nd["parallelism"], nd.get("description", ""))
+                Node(nd["node_id"], OpName(nd["op"]), _config_from_json(nd["config"]),
+                     nd["parallelism"], nd.get("description", ""))
             )
         for ed in d["edges"]:
             g.add_edge(ed["src"], ed["dst"], EdgeType(ed["edge_type"]), Schema.from_json(ed["schema"]))
@@ -167,17 +168,51 @@ class Graph:
 
 
 def _jsonable(obj):
-    """Best-effort conversion of node configs to JSON-safe values.
+    """Conversion of node configs to JSON-safe values with full round-trip
+    for the planner-produced surface: expression ASTs serialize as tagged
+    trees (expr.expr_to_json — the reference's protobuf-plan analog,
+    api.proto:30-110), schemas as tagged dicts. Callables (e.g. the
+    in-process input_dtype_of convenience) are dropped — the planner also
+    records the declarative "input_dtypes" map operators rebuild it from.
+    Anything else degrades to a repr string for display-only graphs."""
+    from .expr import Expr, expr_to_json
 
-    Expression ASTs inside configs are kept as repr strings for display; the
-    planner keeps the live objects on the in-memory graph it hands the engine.
-    """
     if isinstance(obj, dict):
-        return {k: _jsonable(v) for k, v in obj.items()}
+        # input_dtype_of is rebuildable from the serialized "input_dtypes"
+        # map; any OTHER callable marks the graph unshippable so the
+        # round-trip check fails loudly and the control plane ships SQL
+        return {
+            k: ({"__callable__": repr(v)} if callable(v) else _jsonable(v))
+            for k, v in obj.items()
+            if not (k == "input_dtype_of" and callable(v))
+        }
     if isinstance(obj, (list, tuple)):
         return [_jsonable(v) for v in obj]
     if isinstance(obj, (str, int, float, bool)) or obj is None:
         return obj
+    if isinstance(obj, Expr):
+        return expr_to_json(obj)
     if isinstance(obj, Schema):
-        return obj.to_json()
+        return {"__schema__": obj.to_json()}
     return repr(obj)
+
+
+def _config_from_json(obj):
+    from .expr import expr_from_json
+
+    if isinstance(obj, dict):
+        if "__e__" in obj:
+            return expr_from_json(obj)
+        if "__schema__" in obj:
+            return Schema.from_json(obj["__schema__"])
+        if "__callable__" in obj:
+            raise ValueError(
+                f"graph config holds a live callable and cannot ship as IR: "
+                f"{obj['__callable__']}"
+            )
+        return {k: _config_from_json(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        # planner configs carry pair-lists ((name, expr), ...); tuples and
+        # lists are interchangeable for every consumer
+        return [_config_from_json(v) for v in obj]
+    return obj

@@ -25,7 +25,7 @@ from ..engine.engine import register_operator
 from ..expr import eval_expr
 from ..graph import OpName
 from ..operators.base import Operator, TableSpec
-from ..windows.tumbling import acc_plan
+from ..windows.tumbling import acc_plan, dtype_of_from_config
 
 IS_RETRACT_FIELD = "_is_retract"
 
@@ -48,7 +48,7 @@ class UpdatingAggregate(Operator):
     def __init__(self, cfg: dict):
         self.key_fields: list[str] = list(cfg.get("key_fields", ()))
         self.aggregates = cfg["aggregates"]
-        dtype_of = cfg.get("input_dtype_of") or (lambda e: np.dtype(np.float64))
+        dtype_of = dtype_of_from_config(cfg)
         self.acc_kinds, self.acc_dtypes, self.acc_inputs = acc_plan(self.aggregates, dtype_of)
         self.flush_interval = int(cfg.get("flush_interval_micros", 1_000_000))
         self.ttl = int(cfg.get("ttl_micros", 24 * 3600 * 1_000_000))

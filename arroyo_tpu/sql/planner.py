@@ -673,6 +673,9 @@ class Planner:
             "key_fields": key_fields,
             "aggregates": aggregates,
             "input_dtype_of": dtype_of,
+            # declarative twin of the callable above: survives graph
+            # serialization so shipped-IR workers can rebuild the resolver
+            "input_dtypes": dict(input_dtypes),
         }
         updating_out = False
         if window is None:

@@ -28,7 +28,7 @@ from ..expr import eval_expr
 from ..graph import OpName
 from ..operators.base import Operator, TableSpec
 from ..types import Watermark
-from .tumbling import WINDOW_END, WINDOW_START, acc_plan
+from .tumbling import WINDOW_END, WINDOW_START, acc_plan, dtype_of_from_config
 
 
 def _combine(kind: str, a, b):
@@ -59,7 +59,7 @@ class SessionAggregate(Operator):
         self.key_fields: list[str] = list(cfg.get("key_fields", ()))
         self.aggregates = cfg["aggregates"]
         self.final_projection = cfg.get("final_projection")
-        dtype_of = cfg.get("input_dtype_of") or (lambda e: np.dtype(np.float64))
+        dtype_of = dtype_of_from_config(cfg)
         self.acc_kinds, self.acc_dtypes, self.acc_inputs = acc_plan(self.aggregates, dtype_of)
         # key-hash -> sorted-by-min_ts list of open sessions
         self.sessions: dict[int, list[_Session]] = {}

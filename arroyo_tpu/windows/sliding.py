@@ -30,7 +30,7 @@ from ..expr import eval_expr
 from ..graph import OpName
 from ..operators.base import Operator, TableSpec
 from ..types import Watermark
-from .tumbling import WINDOW_END, WINDOW_START, KeyDictionary, acc_plan
+from .tumbling import WINDOW_END, WINDOW_START, KeyDictionary, acc_plan, dtype_of_from_config
 
 
 class SlidingAggregate(Operator):
@@ -50,7 +50,7 @@ class SlidingAggregate(Operator):
         self.key_fields: list[str] = list(cfg.get("key_fields", ()))
         self.aggregates = cfg["aggregates"]
         self.final_projection = cfg.get("final_projection")
-        dtype_of = cfg.get("input_dtype_of") or (lambda e: np.dtype(np.float64))
+        dtype_of = dtype_of_from_config(cfg)
         self.acc_kinds, self.acc_dtypes, self.acc_inputs = acc_plan(self.aggregates, dtype_of)
         self.backend = cfg.get("backend") or (
             "jax" if config().get("device.enabled") else "numpy"

@@ -42,6 +42,23 @@ WINDOW_END = "window_end"
 _PIPELINE_DEPTH = 16
 
 
+def dtype_of_from_config(cfg: dict):
+    """Accumulator-input dtype resolver: the in-process planner hands a live
+    callable; graphs that crossed a process boundary (shipped IR) carry the
+    declarative "input_dtypes" column map instead and rebuild it here."""
+    fn = cfg.get("input_dtype_of")
+    if fn is not None:
+        return fn
+    dtypes = cfg.get("input_dtypes")
+    if dtypes:
+        from ..batch import Field
+        from ..sql.compile import infer_dtype
+
+        dmap = dict(dtypes)
+        return lambda e: Field("_", infer_dtype(e, dmap)).numpy_dtype()
+    return lambda e: np.dtype(np.float64)
+
+
 def acc_plan(aggregates: list[tuple[str, str, Optional[Expr]]], schema_dtype_of) -> tuple:
     """Flatten SQL aggregates into accumulator (kind, dtype, input) triples.
 
@@ -140,7 +157,7 @@ class TumblingAggregate(Operator):
         self.key_fields: list[str] = list(cfg.get("key_fields", ()))
         self.aggregates = cfg["aggregates"]
         self.final_projection = cfg.get("final_projection")
-        dtype_of = cfg.get("input_dtype_of") or (lambda e: np.dtype(np.float64))
+        dtype_of = dtype_of_from_config(cfg)
         self.acc_kinds, self.acc_dtypes, self.acc_inputs = acc_plan(self.aggregates, dtype_of)
         self.n_user_accs = len(self.acc_kinds)
         self.backend = cfg.get("backend") or (
