@@ -250,7 +250,7 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     cp = sub.add_parser("cluster", help="api + controller, submit jobs over REST")
     cp.add_argument("--scheduler", default="process",
-                    choices=["embedded", "process", "node"])
+                    choices=["embedded", "process", "node", "kubernetes"])
     cp.add_argument("--api-port", type=int, default=5115)
     cp.add_argument("--db", default=None)
     cp.set_defaults(fn=_cmd_cluster)

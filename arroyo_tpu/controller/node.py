@@ -45,9 +45,14 @@ class NodeServer:
     def __init__(self, api_base: str, slots: int = 16,
                  host: str = "127.0.0.1", port: int = 0,
                  advertise_host: Optional[str] = None):
+        from ..config import config
+
         self.api_base = api_base.rstrip("/")
         self.slots = slots
-        self.node_id = f"node_{uuid.uuid4().hex[:12]}"
+        # explicit id (config node.id / ARROYO_TPU__NODE__ID) lets the
+        # kubernetes scheduler correlate the pod it created with the node
+        # registration that dials home
+        self.node_id = config().get("node.id") or f"node_{uuid.uuid4().hex[:12]}"
         self._workers: dict[str, object] = {}  # worker_id -> ProcessWorkerHandle
         self._lock = threading.Lock()
         outer = self

@@ -1,10 +1,11 @@
 """Schedulers: how a job's dataflow gets executed.
 
 Reference: crates/arroyo-controller/src/schedulers/mod.rs:43-62 (trait
-Scheduler) with ProcessScheduler (spawn worker subprocesses) and
-EmbeddedScheduler (in-process tasks for `arroyo run`). The kubernetes and
-node schedulers of the reference map to the same WorkerHandle contract and
-are left to the deployment layer.
+Scheduler). All four reference schedulers are implemented against the same
+WorkerHandle contract: EmbeddedScheduler (in-process tasks for the run
+CLI), ProcessScheduler (worker subprocesses), NodeScheduler (placement on
+registered node daemons, this module), and KubernetesScheduler (one worker
+pod per job, controller/kube.py).
 
 Pipelines are defined by SQL text; workers re-plan locally, so no live
 expression objects cross the process boundary (the reference ships protobuf
@@ -388,4 +389,11 @@ def scheduler_for(name: str, db=None) -> Scheduler:
         if db is None:
             raise ValueError("node scheduler needs the shared database")
         return NodeScheduler(db)
-    raise ValueError(f"unknown scheduler {name!r} (have: embedded, process, node)")
+    if name == "kubernetes":
+        if db is None:
+            raise ValueError("kubernetes scheduler needs the shared database")
+        from .kube import KubernetesScheduler
+
+        return KubernetesScheduler(db)
+    raise ValueError(
+        f"unknown scheduler {name!r} (have: embedded, process, node, kubernetes)")

@@ -1,0 +1,178 @@
+"""Kubernetes scheduler (controller/kube.py) against a fake kube-apiserver:
+pod creation with the node-id correlation env, full job lifecycle through
+the pod's node daemon, pod deletion on kill/finish.
+Reference: arroyo-controller/src/schedulers/kubernetes/mod.rs."""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+
+class FakeKubeApi(threading.Thread):
+    """Accepts pod create/delete; 'runs' each created pod by starting an
+    in-process NodeServer with the pod's injected node id."""
+
+    def __init__(self, cluster_api_base: str):
+        super().__init__(daemon=True)
+        self.cluster_api_base = cluster_api_base
+        self.pods: dict[str, dict] = {}
+        self.created: list[dict] = []
+        self.nodes: dict[str, object] = {}
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, payload):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                manifest = json.loads(self.rfile.read(n))
+                name = manifest["metadata"]["name"]
+                outer.pods[name] = manifest
+                outer.created.append(manifest)
+                outer._start_pod(name, manifest)
+                self._json(201, manifest)
+
+            def do_DELETE(self):
+                name = self.path.rsplit("/", 1)[1]
+                outer._stop_pod(name)
+                self._json(200, {})
+
+            def do_GET(self):
+                name = self.path.rsplit("/", 1)[1]
+                if name in outer.pods:
+                    self._json(200, outer.pods[name])
+                else:
+                    self._json(404, {"error": "notfound"})
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.srv.server_address[1]
+        self.base_url = f"http://127.0.0.1:{self.port}"
+
+    def _start_pod(self, name, manifest):
+        from arroyo_tpu import config as cfg
+        from arroyo_tpu.controller.node import NodeServer
+
+        env = {e["name"]: e.get("value") for e in
+               manifest["spec"]["containers"][0]["env"] if "value" in e}
+        node_id = env["ARROYO_TPU__NODE__ID"]
+        cfg.update({"node.id": node_id})
+        try:
+            self.nodes[name] = NodeServer(self.cluster_api_base, slots=1).start()
+        finally:
+            cfg.update({"node.id": None})
+
+    def _stop_pod(self, name):
+        self.pods.pop(name, None)
+        node = self.nodes.pop(name, None)
+        if node is not None:
+            node.stop()
+
+    def run(self):
+        self.srv.serve_forever()
+
+    def close(self):
+        self.srv.shutdown()
+
+
+def test_kubernetes_scheduler_lifecycle(tmp_path, _storage):
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.api import ApiServer
+    from arroyo_tpu.controller import ControllerServer, Database
+    from arroyo_tpu.controller.kube import KubeClient, KubernetesScheduler
+
+    os.environ["ARROYO_TPU__CHECKPOINT__STORAGE_URL"] = cfg.config().get(
+        "checkpoint.storage-url")
+    inp = tmp_path / "in.json"
+    with open(inp, "w") as f:
+        for i in range(100):
+            f.write(json.dumps({"x": i, "timestamp": i * 1000}) + "\n")
+    out_path = tmp_path / "out.json"
+    sql = f"""
+CREATE TABLE src (timestamp TIMESTAMP, x BIGINT)
+WITH (connector = 'single_file', path = '{inp}', format = 'json', type = 'source', event_time_field = 'timestamp');
+CREATE TABLE snk (x BIGINT, t BIGINT)
+WITH (connector = 'single_file', path = '{out_path}', format = 'json', type = 'sink');
+INSERT INTO snk SELECT x, x * 3 AS t FROM src;
+"""
+    db = Database()
+    api = ApiServer(db).start()
+    fake = FakeKubeApi(f"http://127.0.0.1:{api.port}")
+    fake.start()
+    cfg.update({"kubernetes-scheduler.namespace": "test-ns",
+                "kubernetes-scheduler.image": "arroyo-tpu:test",
+                "kubernetes-scheduler.pod-startup-timeout-s": 30})
+    sched = KubernetesScheduler(db, KubeClient(base_url=fake.base_url))
+    ctl = ControllerServer(db, sched).start()
+    try:
+        pid = db.create_pipeline("kpipe", sql, 1)
+        jid = db.create_job(pid)
+        state = ctl.wait_for_state(jid, "Finished", timeout=120)
+        assert state == "Finished"
+        rows = [json.loads(l) for l in open(out_path)]
+        assert len(rows) == 100 and all(r["t"] == r["x"] * 3 for r in rows)
+        # exactly one pod was created, carrying the correlation env and the
+        # configured image, and it was deleted after the job finished
+        assert len(fake.created) == 1
+        manifest = fake.created[0]
+        cont = manifest["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in cont["env"] if "value" in e}
+        assert cont["image"] == "arroyo-tpu:test"
+        assert env["ARROYO_TPU__NODE__ID"].startswith("node_")
+        assert manifest["metadata"]["labels"]["app"] == "arroyo-tpu-worker"
+        deadline = time.time() + 10
+        while fake.pods and time.time() < deadline:
+            time.sleep(0.1)
+        assert not fake.pods, "pod not deleted after job finished"
+    finally:
+        os.environ.pop("ARROYO_TPU__CHECKPOINT__STORAGE_URL", None)
+        ctl.stop()
+        fake.close()
+        api.stop()
+
+
+def test_kubernetes_pod_never_registers_times_out(_storage):
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.controller import Database
+    from arroyo_tpu.controller.kube import KubeClient, KubernetesScheduler
+
+    class NullKube(KubeClient):
+        def __init__(self):
+            self.deleted = []
+            super().__init__(base_url="http://127.0.0.1:1")
+
+        def create_pod(self, namespace, manifest):
+            return manifest  # accepted but nothing ever starts
+
+        def delete_pod(self, namespace, name):
+            self.deleted.append(name)
+
+        def pod_phase(self, namespace, name):
+            return "Pending"
+
+    cfg.update({"kubernetes-scheduler.pod-startup-timeout-s": 1})
+    kube = NullKube()
+    sched = KubernetesScheduler(Database(), kube)
+    # start_worker is non-blocking now: it returns a pending handle whose
+    # poll_events declares failure once the startup deadline passes
+    handle = sched.start_worker("SELECT 1", "job_x", 1, None)
+    deadline = time.time() + 10
+    events = []
+    while not events and time.time() < deadline:
+        events = handle.poll_events()
+        time.sleep(0.1)
+    assert events and events[0]["event"] == "failed"
+    assert "never registered" in events[0]["error"]
+    assert len(kube.deleted) == 1  # the orphaned pod is cleaned up
+    assert not handle.alive()
