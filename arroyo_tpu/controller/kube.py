@@ -47,7 +47,7 @@ class KubeClient:
         self.base_url = base_url.rstrip("/")
         self._static_token = token
         self._token: Optional[str] = token
-        self._token_read_at = 0.0
+        self._token_read_at: Optional[float] = None  # monotonic starts at boot
         self.ctx: Optional[ssl.SSLContext] = None
         if self.base_url.startswith("https"):
             self.ctx = ssl.create_default_context(
@@ -61,7 +61,8 @@ class KubeClient:
         if self._static_token is not None:
             return self._static_token
         now = time.monotonic()
-        if now - self._token_read_at > _TOKEN_TTL_S and os.path.exists(f"{_SA}/token"):
+        if (self._token_read_at is None or now - self._token_read_at > _TOKEN_TTL_S) \
+                and os.path.exists(f"{_SA}/token"):
             with open(f"{_SA}/token") as f:
                 self._token = f.read().strip()
             self._token_read_at = now

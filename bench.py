@@ -109,6 +109,42 @@ def build_q5(rows_sink, backend, event_count, latency_log, arrival_walls):
     return g
 
 
+SESSION_GAP = 2_000_000  # qs session gap
+
+
+def build_qs(rows_sink, backend, event_count, latency_log, arrival_walls):
+    """Session windows per bidder (BASELINE config #5 shape): bursty
+    per-bidder activity with gaps — COUNT + SUM(price) per session."""
+    from arroyo_tpu.batch import TIMESTAMP_FIELD, Schema
+    from arroyo_tpu.expr import Col
+    from arroyo_tpu.graph import EdgeType, Graph, Node, OpName
+
+    S = Schema.of([("x", "int64"), (TIMESTAMP_FIELD, "int64")])
+    g = Graph()
+    g.add_node(_source_node(event_count, ["bid.bidder", "bid.price"]))
+    g.add_node(Node("bids", OpName.VALUE, {
+        "projections": [("bidder", Col("bid.bidder")), ("price", Col("bid.price"))],
+        "filter": Col("bid")}, 1))
+    g.add_node(Node("wm", OpName.WATERMARK, {
+        "expr": Col(TIMESTAMP_FIELD), "interval_micros": 1_000_000,
+        "latency_log": latency_log}, 1))
+    g.add_node(Node("key", OpName.KEY, {"keys": [("bidder", Col("bidder"))]}, 1))
+    g.add_node(Node("agg", OpName.SESSION_AGGREGATE, {
+        "gap_micros": SESSION_GAP,
+        "key_fields": ["bidder"],
+        "aggregates": [("bids", "count", None), ("spend", "sum", Col("price"))],
+        "input_dtype_of": lambda e: np.dtype(np.int64)}, 1))
+    g.add_node(Node("sink", OpName.SINK, {
+        "connector": "vec", "rows": rows_sink, "columnar": True,
+        "arrival_walls": arrival_walls}, 1))
+    g.add_edge("src", "bids", EdgeType.FORWARD, S)
+    g.add_edge("bids", "wm", EdgeType.FORWARD, S)
+    g.add_edge("wm", "key", EdgeType.FORWARD, S)
+    g.add_edge("key", "agg", EdgeType.SHUFFLE, S)
+    g.add_edge("agg", "sink", EdgeType.FORWARD, S)
+    return g
+
+
 def build_q8(rows_sink, backend, event_count, latency_log, arrival_walls):
     """Auctions JOIN bids on auction id within tumbling windows. Denser
     event time (100us) so windows carry join-sized inputs."""
@@ -212,6 +248,26 @@ def oracle_q5(event_count):
     return out
 
 
+def oracle_qs(event_count):
+    """(session_start, bidder) -> (count, spend) with gap-merged sessions."""
+    from arroyo_tpu.batch import TIMESTAMP_FIELD
+
+    b = _gen_events(event_count, ["bid.bidder", "bid.price"])
+    is_bid = np.asarray(b["bid"])
+    bidder = np.asarray(b["bid.bidder"])[is_bid]
+    price = np.asarray(b["bid.price"])[is_bid]
+    ts = np.asarray(b[TIMESTAMP_FIELD])[is_bid]
+    out: dict = {}
+    order = np.lexsort((ts, bidder))
+    bs, tss, ps = bidder[order], ts[order], price[order]
+    i0 = 0
+    for i in range(1, len(bs) + 1):
+        if i == len(bs) or bs[i] != bs[i - 1] or tss[i] - tss[i - 1] > SESSION_GAP:
+            out[(int(tss[i0]), int(bs[i0]))] = (i - i0, int(ps[i0:i].sum()))
+            i0 = i
+    return out
+
+
 def oracle_q8(event_count):
     """(window_start, auction_id) -> n_auction_events * n_bid_events."""
     from arroyo_tpu.batch import TIMESTAMP_FIELD
@@ -309,6 +365,23 @@ def check_parity_q5(rows, event_count):
     return sum(got.values())
 
 
+def check_parity_qs(rows, event_count):
+    got: dict = {}
+    for b in rows:
+        ws = np.asarray(b["window_start"])
+        bd = np.asarray(b["bidder"])
+        cnt = np.asarray(b["bids"])
+        sp = np.asarray(b["spend"])
+        for i in range(b.num_rows):
+            got[(int(ws[i]), int(bd[i]))] = (int(cnt[i]), int(sp[i]))
+    want = oracle_qs(event_count)
+    assert got == want, (
+        f"qs parity failure: {len(got)} sessions vs {len(want)}; "
+        f"first diff: {next(iter(set(got.items()) ^ set(want.items())), None)}"
+    )
+    return sum(c for c, _s in got.values())
+
+
 def check_parity_q8(rows, event_count):
     from arroyo_tpu.batch import TIMESTAMP_FIELD
 
@@ -358,10 +431,14 @@ def main() -> None:
 
         return np.asarray(batch[TIMESTAMP_FIELD]) + WIDTH
 
+    def window_end_session(batch):
+        return np.asarray(batch["window_end"])
+
     configs = [
         ("q7", build_q7, check_parity_q7, window_end_tumbling, events),
         ("q5", build_q5, check_parity_q5, window_end_tumbling, events // 2),
         ("q8", build_q8, check_parity_q8, window_end_q8, events // 4),
+        ("qs", build_qs, check_parity_qs, window_end_session, events // 4),
     ]
     extra: dict = {}
     q7_eps = 0.0

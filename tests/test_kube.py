@@ -110,6 +110,8 @@ INSERT INTO snk SELECT x, x * 3 AS t FROM src;
     api = ApiServer(db).start()
     fake = FakeKubeApi(f"http://127.0.0.1:{api.port}")
     fake.start()
+    # conftest's autouse _storage fixture cfg.reset()s per test, so these
+    # process-global updates cannot leak across tests
     cfg.update({"kubernetes-scheduler.namespace": "test-ns",
                 "kubernetes-scheduler.image": "arroyo-tpu:test",
                 "kubernetes-scheduler.pod-startup-timeout-s": 30})
