@@ -66,7 +66,10 @@ def init_logging(fmt: Optional[str] = None, level: Optional[str] = None) -> None
 
 
 class AdminServer:
-    """Per-process admin endpoint: /metrics (prometheus), /status, /config."""
+    """Per-process admin endpoint: /metrics (prometheus), /status, /config,
+    /debug/pprof/heap (tracemalloc snapshot — the reference serves jemalloc
+    heap profiles from the same path, arroyo-server-common/src/lib.rs:257)
+    and /debug/threads (py-spy-style stack dump)."""
 
     def __init__(self, service: str, port: int = 0, host: str = "127.0.0.1"):
         self.service = service
@@ -94,6 +97,46 @@ class AdminServer:
                     from .config import config
 
                     body = json.dumps(config()._data, default=str).encode()
+                    ctype = "application/json"
+                elif path == "/debug/pprof/heap":
+                    import tracemalloc
+
+                    q = self.path.split("?", 1)[1] if "?" in self.path else ""
+                    if q == "stop":
+                        tracemalloc.stop()
+                        body = json.dumps({"status": "tracing stopped"}).encode()
+                    elif not tracemalloc.is_tracing():
+                        # lineno statistics only render one frame, so one is
+                        # all we pay for; ?stop disables tracing again
+                        tracemalloc.start(1)
+                        body = json.dumps({
+                            "status": "tracing started; fetch again for a "
+                                      "snapshot, ?stop to disable"
+                        }).encode()
+                    else:
+                        snap = tracemalloc.take_snapshot()
+                        stats = snap.statistics("lineno")
+                        body = json.dumps({
+                            "total_kb": round(sum(s_.size for s_ in stats) / 1024, 1),
+                            "top": [
+                                {"site": str(s_.traceback), "kb": round(s_.size / 1024, 1),
+                                 "count": s_.count}
+                                for s_ in stats[:50]
+                            ],
+                        }).encode()
+                    ctype = "application/json"
+                elif path == "/debug/threads":
+                    import sys as _sys
+                    import traceback as _tb
+
+                    frames = _sys._current_frames()
+                    dump = {}
+                    for t in threading.enumerate():
+                        f = frames.get(t.ident)
+                        if f is not None:
+                            # names collide (several admin/prefetch threads)
+                            dump[f"{t.name}-{t.ident}"] = _tb.format_stack(f)
+                    body = json.dumps(dump).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

@@ -156,3 +156,30 @@ def test_scalar_udf_sql(tmp_path, _storage):
         assert sorted(r["t3"] for r in pp.sinks[0].rows) == [i * 3 for i in range(10)]
     finally:
         drop_udf("triple")
+
+
+def test_admin_debug_endpoints(_storage):
+    """Heap profile (tracemalloc) and thread dump on the admin server
+    (reference: /debug/pprof/heap, arroyo-server-common/src/lib.rs:257)."""
+    import json as _json
+    import urllib.request
+
+    from arroyo_tpu.server_common import AdminServer
+
+    srv = AdminServer("test", port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        first = _json.load(urllib.request.urlopen(f"{base}/debug/pprof/heap", timeout=10))
+        second = _json.load(urllib.request.urlopen(f"{base}/debug/pprof/heap", timeout=10))
+        snap = second if "top" in second else first
+        assert "top" in snap and isinstance(snap["top"], list) and snap["top"]
+        stopped = _json.load(urllib.request.urlopen(
+            f"{base}/debug/pprof/heap?stop", timeout=10))
+        assert stopped["status"] == "tracing stopped"
+        threads = _json.load(urllib.request.urlopen(f"{base}/debug/threads", timeout=10))
+        assert any(k.startswith("MainThread-") for k in threads)
+    finally:
+        srv.stop()
+        import tracemalloc
+
+        tracemalloc.stop()
