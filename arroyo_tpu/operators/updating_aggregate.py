@@ -46,6 +46,8 @@ class UpdatingAggregate(Operator):
     input_dtype_of."""
 
     def __init__(self, cfg: dict):
+        from ..config import config
+
         self.key_fields: list[str] = list(cfg.get("key_fields", ()))
         self.aggregates = cfg["aggregates"]
         dtype_of = dtype_of_from_config(cfg)
@@ -56,6 +58,33 @@ class UpdatingAggregate(Operator):
         self.key_values: dict[int, tuple] = {}
         self.updated: set[int] = set()
         self.max_event_time: int = 0
+        # device lowering (sum/count/avg — the invertible kinds): running
+        # accumulators live in HBM as signed scatter lanes (append +v,
+        # retract -v; the count rides as a ±1 sum lane), so the per-batch
+        # hot path is one fused device step with NO per-key Python loop.
+        # The flush gathers only the touched keys' slots — a bounded gather
+        # once per interval, never in the batch loop. min/max stay host-side
+        # (non-invertible; reference rejects them over updating inputs too).
+        backend = cfg.get("backend") or (
+            "jax" if config().get("device.enabled") else "numpy"
+        )
+        self.device_mode = (
+            backend == "jax"
+            and all(k in ("sum", "count") for k in self.acc_kinds)
+        )
+        # the device store always carries a count lane (±1 per row): it is
+        # the liveness/ordering ground truth even when the SQL has no
+        # count(*) — sum-only configs would otherwise misread "sums to
+        # zero" as "key dead"
+        self._count_lane = next(
+            (i for i, k in enumerate(self.acc_kinds) if k == "count"), None)
+        self._synthetic_count = self.device_mode and self._count_lane is None
+        if self._synthetic_count:
+            self._count_lane = len(self.acc_kinds)
+        self._dev = None  # SlotAggregator, built lazily
+        self._dead_since_compact = 0
+        self._last_update: dict[int, int] = {}  # key hash -> event time
+        self._emitted: dict[int, tuple] = {}  # key hash -> last appended vals
 
     # ------------------------------------------------------------------
 
@@ -68,16 +97,28 @@ class UpdatingAggregate(Operator):
     def on_start(self, ctx):
         tbl = ctx.table_manager.expiring_time_key("s", self.ttl)
         batches = tbl.all_batches()
+        if batches and self.device_mode:
+            self._restore_device(Batch.concat(batches))
+            tbl.replace_all([])
+            return
         if batches:
             b = Batch.concat(batches)
             hashes = b.keys.astype(np.uint64).view(np.int64)
             key_cols = [b[f] for f in self.key_fields]
             emitted_mask = b["__has_emitted"].astype(bool) if "__has_emitted" in b else None
             n_agg = len(self.aggregates)
+            count_i = next(
+                (i for i, k in enumerate(self.acc_kinds) if k == "count"), None)
             for j in range(b.num_rows):
                 h = int(hashes[j])
                 accs = [d.type(b[f"__acc_{i}"][j]) for i, d in enumerate(self.acc_dtypes)]
-                st = _KeyState(accs, int(b["__count"][j]), int(b.timestamps[j]))
+                if "__count" in b:
+                    count = int(b["__count"][j])
+                elif count_i is not None:
+                    count = int(accs[count_i])  # device-mode checkpoint layout
+                else:
+                    count = 1
+                st = _KeyState(accs, count, int(b.timestamps[j]))
                 if emitted_mask is not None and emitted_mask[j]:
                     st.emitted = tuple(
                         b[f"__emitted_{i}"][j] for i in range(n_agg)
@@ -116,6 +157,9 @@ class UpdatingAggregate(Operator):
                 vals.append(np.ones(n, dtype=dt))
             else:
                 vals.append(np.asarray(eval_expr(inp, batch.columns, n)).astype(dt))
+        if self.device_mode:
+            self._process_device(hashes, ts, retracts, vals, batch)
+            return
         order = np.argsort(hashes, kind="stable")
         k_s = hashes[order]
         r_s = retracts[order]
@@ -169,6 +213,175 @@ class UpdatingAggregate(Operator):
 
         return _identity(self.acc_kinds[i], self.acc_dtypes[i])
 
+    def _key_columns(self, hashes) -> dict:
+        """Group-by columns for the given key hashes (shared by emission and
+        both checkpoint layouts)."""
+        from ..batch import object_column
+
+        cols: dict = {}
+        for j, f in enumerate(self.key_fields):
+            vals = [self.key_values.get(int(h), (None,) * len(self.key_fields))[j]
+                    for h in hashes]
+            sample = next((v for v in vals if v is not None), None)
+            if isinstance(sample, (str, type(None))):
+                cols[f] = object_column(vals)
+            else:
+                cols[f] = np.array(vals)
+        return cols
+
+    # ------------------------------------------------------- device lowering
+
+    def _dev_dtypes(self) -> tuple:
+        if self._synthetic_count:
+            return self.acc_dtypes + (np.dtype(np.int64),)
+        return self.acc_dtypes
+
+    def _device(self):
+        if self._dev is None:
+            from ..config import config
+            from ..ops.slot_agg import SlotAggregator
+
+            dev = config().section("device")
+            # every lane is a signed sum (count = sum of ±1)
+            self._dev = SlotAggregator(
+                tuple("sum" for _ in self._dev_dtypes()),
+                self._dev_dtypes(),
+                cap=dev.get("table-capacity", 65536),
+                batch_cap=dev.get("batch-capacity", 8192),
+                emit_cap=dev.get("emit-capacity", 8192),
+                backend="jax",
+                region_size=dev.get("region-size", 2048),
+            )
+        return self._dev
+
+    def _process_device(self, hashes, ts, retracts, vals, batch) -> None:
+        n = len(hashes)
+        sign = np.where(retracts, -1, 1).astype(np.int64)
+        signed = []
+        for v, kind, dt in zip(vals, self.acc_kinds, self.acc_dtypes):
+            if kind == "count":
+                signed.append(sign.astype(dt))
+            else:
+                signed.append((np.asarray(v) * sign).astype(dt))
+        if self._synthetic_count:
+            signed.append(sign)
+        self._device().update(hashes.view(np.uint64), np.zeros(n, dtype=np.int32),
+                              signed)
+        uniq, first = np.unique(hashes, return_index=True)
+        mx = np.zeros(len(uniq), dtype=np.int64)
+        np.maximum.at(mx, np.searchsorted(uniq, hashes), np.asarray(ts))
+        lu = self._last_update
+        for h, t in zip(uniq.tolist(), mx.tolist()):
+            prev = lu.get(h)
+            if prev is None or t > prev:
+                lu[h] = t
+        self.updated.update(uniq.tolist())
+        if self.key_fields:
+            cols = [np.asarray(batch[f]) for f in self.key_fields]
+            kv = self.key_values
+            for h, i in zip(uniq.tolist(), first.tolist()):
+                if h not in kv:
+                    kv[h] = tuple(c[i] for c in cols)
+
+    def _device_values(self, keys: list[int]) -> list[tuple]:
+        """Current accumulator tuples for the given key hashes (device
+        gather + host spill lookups)."""
+        agg = self._device()
+        dts = self._dev_dtypes()
+        key_u64 = np.array(keys, dtype=np.int64).view(np.uint64)
+        slots = agg.slots_of(key_u64)
+        on_dev = slots >= 0
+        dev_vals = agg.read_slots(slots[on_dev]) if on_dev.any() else []
+        out: list[list] = [[None] * len(dts) for _ in keys]
+        di = 0
+        for i, ondev in enumerate(on_dev.tolist()):
+            if ondev:
+                for j in range(len(dts)):
+                    out[i][j] = dev_vals[j][di]
+                di += 1
+            else:
+                spill = agg.spill.get((0, int(key_u64.view(np.int64)[i])))
+                for j in range(len(dts)):
+                    out[i][j] = spill[j] if spill is not None else dts[j].type(0)
+        return [tuple(row) for row in out]
+
+    def _flush_device(self, collector, evict_before) -> None:
+        from ..ops.aggregate import finalize_aggs
+
+        count_i = self._count_lane
+        touched = sorted(self.updated)
+        self.updated.clear()
+        out_rows: list[tuple[int, tuple, bool]] = []
+        dead: list[int] = []
+        if touched:
+            accs = self._device_values(touched)
+            for h, acc in zip(touched, accs):
+                count = int(acc[count_i])
+                if count < 0:
+                    raise RuntimeError(
+                        "retract without matching append for key (updating "
+                        "stream ordering violation)"
+                    )
+                emitted = self._emitted.get(h)
+                if count == 0:
+                    if emitted is not None:
+                        out_rows.append((h, emitted, True))
+                        self._emitted.pop(h, None)
+                    dead.append(h)
+                    continue
+                arrays = [np.array([a], dtype=d)
+                          for a, d in zip(acc[: len(self.acc_dtypes)], self.acc_dtypes)]
+                finals = finalize_aggs([a[1] for a in self.aggregates], arrays)
+                new_vals = tuple(f[0] for f in finals)
+                if emitted is not None:
+                    if emitted == new_vals:
+                        continue
+                    out_rows.append((h, emitted, True))
+                out_rows.append((h, new_vals, False))
+                self._emitted[h] = new_vals
+        if evict_before is not None:
+            dead_set = set(dead)
+            idle = [h for h, t in self._last_update.items()
+                    if t < evict_before and h not in dead_set]
+            if idle:
+                # a returning key must restart from zero, so the evicted
+                # keys' device accumulators are zeroed by scattering their
+                # negated current values (pure sum lanes)
+                vals = self._device_values(idle)
+                neg = [np.array([-v[j] for v in vals], dtype=d)
+                       for j, d in enumerate(self._dev_dtypes())]
+                key_u64 = np.array(idle, dtype=np.int64).view(np.uint64)
+                self._device().update(key_u64, np.zeros(len(idle), dtype=np.int32), neg)
+                for h in idle:
+                    emitted = self._emitted.pop(h, None)
+                    if emitted is not None:
+                        out_rows.append((h, emitted, True))
+                    dead.append(h)
+        if out_rows:
+            self._emit(out_rows, collector)
+        for h in dead:
+            self._last_update.pop(h, None)
+            self.key_values.pop(h, None)
+        self._dead_since_compact += len(dead)
+        self._maybe_compact()
+
+    def _maybe_compact(self) -> None:
+        """Dead keys leave their device slots assigned (eviction only zeroes
+        values); once a quarter of the table has died, rebuild the store
+        from the live snapshot so slot/spill capacity is reclaimed and
+        checkpoints scale with LIVE keys, not keys-ever-seen."""
+        dev = self._dev
+        if dev is None or self._dead_since_compact < dev.cap // 4:
+            return
+        keys_u64, _bins, accs = dev.snapshot()
+        live = accs[self._count_lane] > 0
+        self._dev = None
+        fresh = self._device()
+        if live.any():
+            fresh.restore(keys_u64[live], np.zeros(int(live.sum()), dtype=np.int32),
+                          [a[live] for a in accs])
+        self._dead_since_compact = 0
+
     # ------------------------------------------------------------------
 
     def _finalize(self, st: _KeyState) -> tuple:
@@ -181,6 +394,9 @@ class UpdatingAggregate(Operator):
     def _flush(self, collector, evict_before: Optional[int] = None) -> None:
         """Emit retract/append pairs for keys whose value changed
         (reference :638-700); TTL-evict idle keys with a retraction."""
+        if self.device_mode:
+            self._flush_device(collector, evict_before)
+            return
         out_rows: list[tuple[int, tuple, bool]] = []  # (hash, values, is_retract)
         dead: list[int] = []
         for h in sorted(self.updated):
@@ -217,16 +433,7 @@ class UpdatingAggregate(Operator):
         n = len(out_rows)
         cols: dict[str, np.ndarray] = {}
         if self.key_fields:
-            for j, f in enumerate(self.key_fields):
-                vals = [
-                    self.key_values.get(h, (None,) * len(self.key_fields))[j]
-                    for h, _v, _r in out_rows
-                ]
-                sample = next((v for v in vals if v is not None), None)
-                if isinstance(sample, (str, type(None))):
-                    cols[f] = np.array(vals, dtype=object)
-                else:
-                    cols[f] = np.array(vals)
+            cols.update(self._key_columns([h for h, _v, _r in out_rows]))
         for i, (name, _k, _e) in enumerate(self.aggregates):
             vals = [v[i] for _h, v, _r in out_rows]
             cols[name] = np.array(vals)
@@ -252,6 +459,9 @@ class UpdatingAggregate(Operator):
         # barrier, then snapshot — otherwise un-flushed updates are lost on
         # restore because the `updated` set is not persisted
         self._flush(collector)
+        if self.device_mode:
+            self._checkpoint_device(ctx)
+            return
         tbl = ctx.table_manager.expiring_time_key("s", self.ttl)
         items = sorted(self.state.items())
         if not items:
@@ -274,17 +484,69 @@ class UpdatingAggregate(Operator):
             ]
             cols[f"__emitted_{i}"] = np.array(vals)
         if self.key_fields:
-            for j, f in enumerate(self.key_fields):
-                vals = [
-                    self.key_values.get(h, (None,) * len(self.key_fields))[j]
-                    for h, _st in items
-                ]
-                sample = next((v for v in vals if v is not None), None)
-                if isinstance(sample, (str, type(None))):
-                    cols[f] = np.array(vals, dtype=object)
-                else:
-                    cols[f] = np.array(vals)
+            cols.update(self._key_columns([h for h, _st in items]))
         tbl.replace_all([Batch(cols)])
+
+
+    # --------------------------------------------- device checkpoint/restore
+
+    def _checkpoint_device(self, ctx) -> None:
+        tbl = ctx.table_manager.expiring_time_key("s", self.ttl)
+        if self._dev is None:
+            tbl.replace_all([])
+            return
+        keys_u64, _bins, accs = self._dev.snapshot()
+        signed = keys_u64.view(np.int64)
+        live = accs[self._count_lane] > 0
+        signed, accs = signed[live], [a[live] for a in accs]
+        if len(signed) == 0:
+            tbl.replace_all([])
+            return
+        n_agg = len(self.aggregates)
+        cols: dict[str, np.ndarray] = {
+            TIMESTAMP_FIELD: np.array(
+                [self._last_update.get(int(h), self.max_event_time) for h in signed],
+                dtype=np.int64),
+            KEY_FIELD: signed.view(np.uint64),
+            "__has_emitted": np.array(
+                [int(h) in self._emitted for h in signed], dtype=bool),
+        }
+        for i, (a, d) in enumerate(zip(accs, self._dev_dtypes())):
+            cols[f"__acc_{i}"] = a.astype(d)
+        for i in range(n_agg):
+            cols[f"__emitted_{i}"] = np.array([
+                self._emitted[int(h)][i] if int(h) in self._emitted else 0
+                for h in signed
+            ])
+        if self.key_fields:
+            cols.update(self._key_columns(signed))
+        tbl.replace_all([Batch(cols)])
+
+    def _restore_device(self, b: Batch) -> None:
+        hashes = b.keys.astype(np.uint64)
+        signed = hashes.view(np.int64)
+        accs = []
+        for i, d in enumerate(self._dev_dtypes()):
+            col = f"__acc_{i}"
+            if col in b:
+                accs.append(np.asarray(b[col]).astype(d))
+            elif i == self._count_lane and "__count" in b:
+                # host-mode checkpoint layout: synthesize the count lane
+                accs.append(np.asarray(b["__count"]).astype(d))
+            else:
+                accs.append(np.zeros(b.num_rows, dtype=d))
+        self._device().restore(hashes, np.zeros(len(signed), dtype=np.int32), accs)
+        emitted_mask = (np.asarray(b["__has_emitted"], dtype=bool)
+                        if "__has_emitted" in b else np.zeros(len(signed), bool))
+        n_agg = len(self.aggregates)
+        key_cols = [b[f] for f in self.key_fields]
+        for j in range(b.num_rows):
+            h = int(signed[j])
+            self._last_update[h] = int(b.timestamps[j])
+            if emitted_mask[j]:
+                self._emitted[h] = tuple(b[f"__emitted_{i}"][j] for i in range(n_agg))
+            if self.key_fields:
+                self.key_values[h] = tuple(c[j] for c in key_cols)
 
 
 def merge_updating_rows(rows: list[dict]) -> list[dict]:

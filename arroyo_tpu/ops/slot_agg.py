@@ -353,11 +353,29 @@ def _build_slot_jax(acc_kinds: tuple, acc_dtypes: tuple, cap: int, region_size: 
             return jax.jit(go, donate_argnums=0)
         return jax.jit(go)
 
+    # point reads for updating aggregates: one small gather of the touched
+    # slots per flush interval (a bounded gather once a second is fine; the
+    # per-batch hot loop stays scatter-only)
+    @functools.lru_cache(maxsize=None)
+    def make_read_slots(k: int):
+        def go(state, slots):
+            outs = []
+            for a, d in zip(state, acc_dtypes):
+                sl = a[slots]
+                if np.issubdtype(np.dtype(d), np.floating):
+                    outs.append(sl.astype(jnp.float64))
+                else:
+                    outs.append(sl.astype(jnp.int64))
+            return tuple(outs)
+
+        return jax.jit(go)
+
     return (
         jax.jit(step, donate_argnums=0),
         jax.jit(step_merge, donate_argnums=0),
         make_read_multi,
         jax.jit(clear, donate_argnums=0),
+        make_read_slots,
     )
 
 
@@ -387,7 +405,8 @@ class SlotAggregator(DeviceHashAggregator):
             self.max_probes = max_probes
             self.emit_cap = emit_cap
             self.backend = backend
-            (self._step, self._step_merge, self._read_multi, self._clear) = \
+            (self._step, self._step_merge, self._read_multi, self._clear,
+             self._read_slots) = \
                 _build_slot_jax(self.acc_kinds, self.acc_dtypes, cap, region_size)
             self._merge_mode = False
             self._n_flt_lanes = sum(
@@ -599,6 +618,50 @@ class SlotAggregator(DeviceHashAggregator):
             del self.spill[kk]
         if below > d.boundary:
             d.boundary = below
+
+    def read_slots(self, slots: np.ndarray) -> list[np.ndarray]:
+        """Current accumulator values at the given device slots (one gather,
+        one fetch; slot count bucketed to powers of two for jit reuse).
+        Used by the updating-aggregate flush; window paths never gather."""
+        n = len(slots)
+        if n == 0:
+            return [np.empty(0, dtype=d) for d in self.acc_dtypes]
+        k = 64
+        while k < n:
+            k *= 2
+        padded = np.zeros(k, dtype=np.int32 if self.cap < _I32_MAX else np.int64)
+        padded[:n] = slots
+        outs = self._read_slots(k)(self.state, padded)
+        from .prefetch import wait_buffers_ready
+
+        wait_buffers_ready(outs)
+        return [np.asarray(o)[:n].astype(d, copy=False)
+                for o, d in zip(outs, self.acc_dtypes)]
+
+    def slots_of(self, key_u64: np.ndarray) -> np.ndarray:
+        """Device slots currently assigned to these (bin=0) keys; -1 for
+        keys living in the host spill tier. Read-only: never allocates."""
+        from .. import native
+
+        d = self.directory
+        ks = np.ascontiguousarray(key_u64, dtype=np.uint64).view(np.int64)
+        zeros = np.zeros(len(ks), dtype=np.int64)
+        res = native.dir_resolve(ks, zeros, d.hcode, d.hbin, d.hslot,
+                                 d.boundary, d.slot_keys, d.slot_bins)
+        if res is not None:
+            return res[0]  # misses stay -1 (unallocated)
+        codes = splitmix64(key_u64.astype(np.uint64))
+        out = np.full(len(ks), -1, dtype=np.int64)
+        for i, (c, k) in enumerate(zip(codes, ks)):
+            h = int(c & d.hmask)
+            for _ in range(d.hcap):
+                if d.hslot[h] < 0 or d.hbin[h] < d.boundary:
+                    break
+                if d.hcode[h] == c and d.slot_keys[d.hslot[h]] == k:
+                    out[i] = d.hslot[h]
+                    break
+                h = (h + 1) & int(d.hmask)
+        return out
 
     # ------------------------------------------------------------- state sync
 

@@ -444,7 +444,9 @@ def main() -> None:
     })
 
     events = int(os.environ.get("ARROYO_BENCH_EVENTS", 2_000_000))
-    base_events = int(os.environ.get("ARROYO_BENCH_BASELINE_EVENTS", 500_000))
+    # same event count as the measured runs: best-of-N on one size vs
+    # best-of-N on another was apples-to-pears
+    base_events = int(os.environ.get("ARROYO_BENCH_BASELINE_EVENTS", events))
     reps = int(os.environ.get("ARROYO_BENCH_REPS", 3))
     # 65536 is the device-link sweet spot after the count-lane/int32-slot
     # byte cuts; the numpy dict-store baseline prefers smaller batches

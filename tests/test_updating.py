@@ -35,6 +35,13 @@ def rows_of(col):
     return out
 
 
+
+
+def _ctx_collector(storage):
+    ti = TaskInfo("updev", "agg", "agg", 0, 1)
+    return OperatorContext(ti, None, TableManager(ti, storage)), FakeCollector()
+
+
 def make_op(aggs=None, ttl=None, storage="/tmp/upd-agg-unused"):
     cfg = {
         "key_fields": ["u"],
@@ -156,3 +163,107 @@ def test_updating_checkpoint_restore(tmp_path):
     assert len(rows) == 2
     assert rows[0][IS_RETRACT_FIELD] is True and rows[0]["cnt"] == 1 and rows[0]["total"] == 1
     assert rows[1][IS_RETRACT_FIELD] is False and rows[1]["cnt"] == 2 and rows[1]["total"] == 11
+
+
+def test_device_mode_matches_host_mode(tmp_path, _storage):
+    """The device-lowered updating aggregate (signed scatter lanes + flush
+    gather) must emit exactly what the host dict path emits, including
+    retract/append pairs, no-op suppression, and TTL evictions."""
+    import numpy as np
+
+    from arroyo_tpu.batch import KEY_FIELD, TIMESTAMP_FIELD, Batch
+    from arroyo_tpu.hashing import hash_columns
+    from arroyo_tpu.operators.updating_aggregate import (
+        UpdatingAggregate,
+        merge_updating_rows,
+    )
+    from arroyo_tpu.expr import Col
+
+    def run(backend):
+        op = UpdatingAggregate({
+            "key_fields": ["k"],
+            "aggregates": [("n", "count", None), ("total", "sum", Col("v")),
+                           ("mean", "avg", Col("v"))],
+            "input_dtype_of": lambda e: np.dtype(np.int64),
+            "ttl_micros": 30_000_000,
+            "backend": backend,
+        })
+        if backend == "jax":
+            assert op.device_mode
+        else:
+            assert not op.device_mode
+        ctx, col = _ctx_collector(str(tmp_path / backend))
+        rng = np.random.default_rng(31)
+        out = []
+        for step in range(8):
+            n = 200
+            # keys 6-11 go idle after step 3 so the TTL eviction branch
+            # fires (retractions for evicted keys) in both modes
+            hi = 12 if step < 4 else 6
+            ks = rng.integers(0, hi, size=n).astype(np.int64)
+            vs = rng.integers(1, 100, size=n).astype(np.int64)
+            ts = np.full(n, step * 10_000_000, dtype=np.int64)
+            op.process_batch(Batch({
+                "k": ks, "v": vs, TIMESTAMP_FIELD: ts,
+                KEY_FIELD: hash_columns([ks]),
+            }), ctx, col)
+            op.handle_tick(ctx, col)
+            out.extend(r for b in col.batches for r in b.to_pylist())
+            col.batches.clear()
+        op.on_close(ctx, col)
+        out.extend(r for b in col.batches for r in b.to_pylist())
+        return merge_updating_rows(out)
+
+    canon = lambda rows: sorted(
+        (r["k"], r["n"], r["total"], round(float(r["mean"]), 9)) for r in rows
+    )
+    host = canon(run("numpy"))
+    dev = canon(run("jax"))
+    assert dev == host
+    # keys 6-11 went idle past the TTL: evicted with retractions, so only
+    # the still-active 6 keys survive the merge — in BOTH modes
+    assert len(dev) == 6 and {k for k, *_ in dev} == set(range(6))
+
+
+def test_device_mode_checkpoint_restore(tmp_path, _storage):
+    """Device-mode snapshot -> restore preserves accumulators, emitted cache
+    (no spurious re-appends) and TTL clocks."""
+    import numpy as np
+
+    from arroyo_tpu.batch import KEY_FIELD, TIMESTAMP_FIELD, Batch
+    from arroyo_tpu.hashing import hash_columns
+    from arroyo_tpu.operators.updating_aggregate import (
+        UpdatingAggregate,
+        merge_updating_rows,
+    )
+    from arroyo_tpu.expr import Col
+    from arroyo_tpu.types import CheckpointBarrier
+
+    cfg_op = {
+        "key_fields": ["k"],
+        "aggregates": [("n", "count", None), ("total", "sum", Col("v"))],
+        "input_dtype_of": lambda e: np.dtype(np.int64),
+        "backend": "jax",
+    }
+    op = UpdatingAggregate(cfg_op)
+    ctx, col = _ctx_collector(str(tmp_path))
+    ks = np.arange(6, dtype=np.int64) % 3
+    vs = (np.arange(6, dtype=np.int64) + 1) * 10
+    b = Batch({"k": ks, "v": vs, TIMESTAMP_FIELD: np.full(6, 1000, dtype=np.int64),
+               KEY_FIELD: hash_columns([ks])})
+    op.process_batch(b, ctx, col)
+    op.handle_checkpoint(CheckpointBarrier(1, 1, 0, False), ctx, col)
+    pre = [r for bb in col.batches for r in bb.to_pylist()]
+
+    op2 = UpdatingAggregate(cfg_op)
+    ctx2, col2 = _ctx_collector(str(tmp_path))
+    ctx2.table_manager = ctx.table_manager
+    op2.on_start(ctx2)
+    # same keys again: restored accumulators continue, restored emitted cache
+    # produces retract/append pairs (not bare appends)
+    op2.process_batch(b, ctx2, col2)
+    op2.on_close(ctx2, col2)
+    post = [r for bb in col2.batches for r in bb.to_pylist()]
+    final = merge_updating_rows(pre + post)
+    got = sorted((r["k"], r["n"], r["total"]) for r in final)
+    assert got == [(0, 4, 2 * (10 + 40)), (1, 4, 2 * (20 + 50)), (2, 4, 2 * (30 + 60))]
