@@ -313,50 +313,58 @@ class UpdatingAggregate(Operator):
         self.updated.clear()
         out_rows: list[tuple[int, tuple, bool]] = []
         dead: list[int] = []
+        zero_keys: list[int] = []  # dead keys whose slots must reset exactly
         if touched:
             accs = self._device_values(touched)
-            for h, acc in zip(touched, accs):
-                count = int(acc[count_i])
-                if count < 0:
-                    raise RuntimeError(
-                        "retract without matching append for key (updating "
-                        "stream ordering violation)"
-                    )
+            counts = np.array([int(a[count_i]) for a in accs], dtype=np.int64)
+            if (counts < 0).any():
+                raise RuntimeError(
+                    "retract without matching append for key (updating "
+                    "stream ordering violation)"
+                )
+            # columnar finalize across ALL touched keys at once — a per-key
+            # Python finalize would re-introduce the loop this lowering
+            # removes
+            lanes = [np.array([a[j] for a in accs], dtype=d)
+                     for j, d in enumerate(self.acc_dtypes)]
+            finals = finalize_aggs([a[1] for a in self.aggregates], lanes)
+            for i, h in enumerate(touched):
                 emitted = self._emitted.get(h)
-                if count == 0:
+                if counts[i] == 0:
                     if emitted is not None:
                         out_rows.append((h, emitted, True))
                         self._emitted.pop(h, None)
                     dead.append(h)
+                    zero_keys.append(h)
                     continue
-                arrays = [np.array([a], dtype=d)
-                          for a, d in zip(acc[: len(self.acc_dtypes)], self.acc_dtypes)]
-                finals = finalize_aggs([a[1] for a in self.aggregates], arrays)
-                new_vals = tuple(f[0] for f in finals)
+                new_vals = tuple(f[i] for f in finals)
                 if emitted is not None:
                     if emitted == new_vals:
                         continue
                     out_rows.append((h, emitted, True))
                 out_rows.append((h, new_vals, False))
                 self._emitted[h] = new_vals
+        idle: list[int] = []
         if evict_before is not None:
             dead_set = set(dead)
             idle = [h for h, t in self._last_update.items()
                     if t < evict_before and h not in dead_set]
-            if idle:
-                # a returning key must restart from zero, so the evicted
-                # keys' device accumulators are zeroed by scattering their
-                # negated current values (pure sum lanes)
-                vals = self._device_values(idle)
-                neg = [np.array([-v[j] for v in vals], dtype=d)
-                       for j, d in enumerate(self._dev_dtypes())]
-                key_u64 = np.array(idle, dtype=np.int64).view(np.uint64)
-                self._device().update(key_u64, np.zeros(len(idle), dtype=np.int32), neg)
-                for h in idle:
-                    emitted = self._emitted.pop(h, None)
-                    if emitted is not None:
-                        out_rows.append((h, emitted, True))
-                    dead.append(h)
+            for h in idle:
+                emitted = self._emitted.pop(h, None)
+                if emitted is not None:
+                    out_rows.append((h, emitted, True))
+                dead.append(h)
+        to_zero = zero_keys + idle
+        if to_zero:
+            # a returning key must restart from zero: scatter the negated
+            # current values (pure sum lanes). This includes count==0 keys —
+            # float lanes can hold rounding residue even when the integer
+            # count lane reads exactly zero.
+            vals = self._device_values(to_zero)
+            neg = [np.array([-v[j] for v in vals], dtype=d)
+                   for j, d in enumerate(self._dev_dtypes())]
+            key_u64 = np.array(to_zero, dtype=np.int64).view(np.uint64)
+            self._device().update(key_u64, np.zeros(len(to_zero), dtype=np.int32), neg)
         if out_rows:
             self._emit(out_rows, collector)
         for h in dead:
@@ -508,6 +516,9 @@ class UpdatingAggregate(Operator):
                 [self._last_update.get(int(h), self.max_event_time) for h in signed],
                 dtype=np.int64),
             KEY_FIELD: signed.view(np.uint64),
+            # explicit __count keeps the layout restorable by the HOST path
+            # too (its sum-only configs have no count column to fall back on)
+            "__count": accs[self._count_lane].astype(np.int64),
             "__has_emitted": np.array(
                 [int(h) in self._emitted for h in signed], dtype=bool),
         }

@@ -30,7 +30,8 @@ from ..expr import eval_expr
 from ..graph import OpName
 from ..operators.base import Operator, TableSpec
 from ..types import Watermark
-from .tumbling import WINDOW_END, WINDOW_START, KeyDictionary, acc_plan, dtype_of_from_config
+from .tumbling import (WINDOW_END, WINDOW_START, KeyDictionary, acc_plan,
+                       dtype_of_from_config, make_window_aggregator)
 
 
 class SlidingAggregate(Operator):
@@ -83,18 +84,12 @@ class SlidingAggregate(Operator):
 
     def _aggregator(self):
         if self._agg is None:
-            from ..ops.slot_agg import SlotAggregator
-
-            dev = config().section("device")
-            self._agg = SlotAggregator(
-                self.acc_kinds,
-                self.acc_dtypes,
-                cap=dev.get("table-capacity", 65536),
-                batch_cap=dev.get("batch-capacity", 8192),
-                emit_cap=dev.get("emit-capacity", 8192),
-                backend=self.backend,
-                region_size=dev.get("region-size", 2048),
-            )
+            # mesh mode shares tumbling's construction path: per-bin partials
+            # sharded over the key space; the incremental per-bin extraction
+            # drives extract_start(b, b+1, b+1), which the sharded store
+            # serves synchronously
+            self._agg = make_window_aggregator(
+                self.acc_kinds, self.acc_dtypes, self.backend)
         return self._agg
 
     def on_start(self, ctx):

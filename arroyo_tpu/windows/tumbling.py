@@ -59,6 +59,38 @@ def dtype_of_from_config(cfg: dict):
     return lambda e: np.dtype(np.float64)
 
 
+def make_window_aggregator(acc_kinds, acc_dtypes, backend: str):
+    """Single-chip SlotAggregator or (device.mesh-devices > 1) the
+    key-space-sharded ShardedAggregator — one construction path shared by
+    every window operator so capacity knobs cannot drift between them."""
+    dev = config().section("device")
+    mesh_n = int(dev.get("mesh-devices", 0) or 0)
+    if backend == "jax" and mesh_n > 1:
+        from ..parallel import ShardedAggregator, make_mesh
+
+        return ShardedAggregator(
+            make_mesh(mesh_n),
+            acc_kinds,
+            acc_dtypes,
+            cap=dev.get("table-capacity", 65536),
+            batch_cap=dev.get("batch-capacity", 8192),
+            max_probes=dev.get("max-probes", 64),
+            emit_cap=dev.get("emit-capacity", 8192),
+            spill_cap=dev.get("spill-capacity", 2048),
+        )
+    from ..ops.slot_agg import SlotAggregator
+
+    return SlotAggregator(
+        acc_kinds,
+        acc_dtypes,
+        cap=dev.get("table-capacity", 65536),
+        batch_cap=dev.get("batch-capacity", 8192),
+        emit_cap=dev.get("emit-capacity", 8192),
+        backend=backend,
+        region_size=dev.get("region-size", 2048),
+    )
+
+
 def acc_plan(aggregates: list[tuple[str, str, Optional[Expr]]], schema_dtype_of) -> tuple:
     """Flatten SQL aggregates into accumulator (kind, dtype, input) triples.
 
@@ -202,37 +234,12 @@ class TumblingAggregate(Operator):
 
     def _aggregator(self):
         if self._agg is None:
-            dev = config().section("device")
-            mesh_n = int(dev.get("mesh-devices", 0) or 0)
-            if self.backend == "jax" and mesh_n > 1:
-                # mesh execution mode: key-space-sharded state over an
-                # n-device mesh, keyed exchange = in-program all_to_all over
-                # ICI (replaces the reference's repartition shuffle,
-                # crates/arroyo-operator/src/context.rs:502-556)
-                from ..parallel import ShardedAggregator, make_mesh
-
-                self._agg = ShardedAggregator(
-                    make_mesh(mesh_n),
-                    self.acc_kinds,
-                    self.acc_dtypes,
-                    cap=dev.get("table-capacity", 65536),
-                    batch_cap=dev.get("batch-capacity", 8192),
-                    max_probes=dev.get("max-probes", 64),
-                    emit_cap=dev.get("emit-capacity", 8192),
-                    spill_cap=dev.get("spill-capacity", 2048),
-                )
-            else:
-                from ..ops.slot_agg import SlotAggregator
-
-                self._agg = SlotAggregator(
-                    self.acc_kinds,
-                    self.acc_dtypes,
-                    cap=dev.get("table-capacity", 65536),
-                    batch_cap=dev.get("batch-capacity", 8192),
-                    emit_cap=dev.get("emit-capacity", 8192),
-                    backend=self.backend,
-                    region_size=dev.get("region-size", 2048),
-                )
+            # mesh execution mode (device.mesh-devices > 1): key-space-
+            # sharded state, keyed exchange = in-program all_to_all over ICI
+            # (replaces the reference's repartition shuffle,
+            # crates/arroyo-operator/src/context.rs:502-556)
+            self._agg = make_window_aggregator(
+                self.acc_kinds, self.acc_dtypes, self.backend)
         return self._agg
 
     def on_start(self, ctx):
