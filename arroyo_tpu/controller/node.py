@@ -119,18 +119,28 @@ class NodeServer:
         body = h._body()
         wid = f"worker_{uuid.uuid4().hex[:12]}"
         with self._lock:
-            used = sum(1 for w in self._workers.values() if w.alive())
+            # a None value is another request's under-lock reservation whose
+            # handle is still being spawned — it holds a slot too
+            used = sum(1 for w in self._workers.values()
+                       if w is None or w.alive())
             if used >= self.slots:
                 # slots are a hard admission limit, not advisory — the
                 # scheduler's status poll races concurrent placements
                 h._json(409, {"error": f"node full ({used}/{self.slots} slots)"})
                 return
             self._workers[wid] = None  # reserve under the lock
-        handle = ProcessWorkerHandle(
-            body["sql"], body["job_id"], int(body.get("parallelism", 1)),
-            body.get("restore_epoch"), body.get("storage_url"),
-            body.get("udf_specs"), body.get("graph_json"),
-        )
+        try:
+            handle = ProcessWorkerHandle(
+                body["sql"], body["job_id"], int(body.get("parallelism", 1)),
+                body.get("restore_epoch"), body.get("storage_url"),
+                body.get("udf_specs"), body.get("graph_json"),
+            )
+        except BaseException:
+            # spawn failure must release the reservation or the slot is
+            # consumed forever (fatal on 1-slot kubernetes worker pods)
+            with self._lock:
+                self._workers.pop(wid, None)
+            raise
         with self._lock:
             self._workers[wid] = handle
         h._json(200, {"worker_id": wid})
@@ -228,6 +238,8 @@ class NodeServer:
         self.httpd.shutdown()
         with self._lock:
             for w in self._workers.values():
+                if w is None:
+                    continue  # in-flight reservation, nothing to kill yet
                 try:
                     w.kill()
                 except Exception:

@@ -335,49 +335,118 @@ class NodeWorkerHandle(WorkerHandle):
         return self._hb
 
 
+class LazyNodeWorkerHandle(WorkerHandle):
+    """Deferred placement on a node daemon. The controller's supervision
+    loop is single-threaded, so start_worker must not block while the
+    cluster is briefly full or a daemon is mid-restart: this handle retries
+    placement from poll_events (same shape as KubernetesWorkerHandle) and
+    queues control commands issued before placement lands."""
+
+    def __init__(self, sched: "NodeScheduler", args: tuple,
+                 placement_timeout_s: float):
+        self._sched = sched
+        self._args = args
+        self._deadline = time.monotonic() + placement_timeout_s
+        self._inner: Optional[NodeWorkerHandle] = None
+        self._queued: list[tuple] = []
+        self._dead = False
+        self._last = "no live node daemons registered"
+
+    def _try_place(self) -> Optional[list[dict]]:
+        inner, self._last = self._sched._place_once(self._args, self._last)
+        if inner is not None:
+            self._inner = inner
+            for cmd in self._queued:
+                getattr(inner, cmd[0])(*cmd[1:])
+            self._queued.clear()
+            return None
+        if time.monotonic() > self._deadline:
+            self._dead = True
+            return [{"event": "failed", "error": f"placement failed: {self._last}"}]
+        return None
+
+    def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
+        if self._inner is None:
+            self._queued.append(("trigger_checkpoint", epoch, then_stop))
+        else:
+            self._inner.trigger_checkpoint(epoch, then_stop)
+
+    def stop(self) -> None:
+        if self._inner is None:
+            self._queued.append(("stop",))
+        else:
+            self._inner.stop()
+
+    def kill(self) -> None:
+        self._dead = True
+        if self._inner is not None:
+            self._inner.kill()
+
+    def poll_events(self) -> list[dict]:
+        if self._dead:
+            return []
+        if self._inner is None:
+            return self._try_place() or []
+        return self._inner.poll_events()
+
+    def alive(self) -> bool:
+        if self._dead:
+            return False
+        return True if self._inner is None else self._inner.alive()
+
+    def last_heartbeat(self) -> float:
+        if self._inner is None:
+            return time.monotonic()  # placement has its own deadline
+        return self._inner.last_heartbeat()
+
+
 class NodeScheduler(Scheduler):
     """Places workers on registered node daemons (least-loaded first)."""
 
     def __init__(self, db):
         self.db = db
 
-    def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
-                     udf_specs=None, graph_json=None,
-                     placement_timeout_s: float = 30.0):
+    def _place_once(self, args: tuple, last: str):
+        """One placement sweep over live daemons -> (handle|None, reason)."""
         import urllib.error
 
         from .node import _get
 
-        # a node daemon mid-restart or a briefly-full cluster is a transient
-        # condition: retry placement for a bounded window instead of letting
-        # the job fail terminally (reference Scheduling waits for workers)
-        deadline = time.monotonic() + placement_timeout_s
-        last = "no live node daemons registered"
-        while time.monotonic() < deadline:
-            nodes = self.db.list_nodes(alive_within_s=10.0)
-            candidates = []
-            for n in nodes:
-                try:
-                    st = _get(f"{n['addr']}/status", timeout=5.0)
-                except OSError:
-                    continue
-                free = int(st["slots"]) - int(st["used"])
-                if free >= 1:
-                    candidates.append((free, n))
-            candidates.sort(key=lambda fn: -fn[0])
-            for _free, n in candidates:
-                try:
-                    return NodeWorkerHandle(n["addr"], sql, job_id, parallelism,
-                                            restore_epoch, storage_url, udf_specs,
-                                            graph_json)
-                except urllib.error.HTTPError as e:
-                    last = f"node {n['id']} rejected placement: {e}"
-                except OSError as e:
-                    last = f"node {n['id']} unreachable: {e}"
-            if nodes:
-                last = "no node daemon with free slots"
-            time.sleep(0.5)
-        raise RuntimeError(last)
+        nodes = self.db.list_nodes(alive_within_s=10.0)
+        candidates = []
+        for n in nodes:
+            try:
+                st = _get(f"{n['addr']}/status", timeout=5.0)
+            except OSError:
+                continue
+            free = int(st["slots"]) - int(st["used"])
+            if free >= 1:
+                candidates.append((free, n))
+        candidates.sort(key=lambda fn: -fn[0])
+        for _free, n in candidates:
+            try:
+                return NodeWorkerHandle(n["addr"], *args), last
+            except urllib.error.HTTPError as e:
+                last = f"node {n['id']} rejected placement: {e}"
+            except OSError as e:
+                last = f"node {n['id']} unreachable: {e}"
+        if nodes and not candidates:
+            last = "no node daemon with free slots"
+        return None, last
+
+    def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
+                     udf_specs=None, graph_json=None,
+                     placement_timeout_s: float = 30.0):
+        args = (sql, job_id, parallelism, restore_epoch, storage_url,
+                udf_specs, graph_json)
+        # fast path: place immediately when capacity exists, so the common
+        # case still fails fast on hard errors and tests see a live handle
+        handle, last = self._place_once(args, "no live node daemons registered")
+        if handle is not None:
+            return handle
+        lazy = LazyNodeWorkerHandle(self, args, placement_timeout_s)
+        lazy._last = last
+        return lazy
 
 
 def scheduler_for(name: str, db=None) -> Scheduler:

@@ -15,9 +15,12 @@ S3 puts are atomic by the service's semantics.
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 from typing import Optional
+
+_log = logging.getLogger("arroyo_tpu.storage")
 
 _s3_client = None
 
@@ -175,26 +178,39 @@ def rmtree(path: str) -> None:
         bucket, key = s3
         client = _get_s3()
         token = None
-        try:
-            while True:
+        errors = 0
+        while True:
+            try:
                 kwargs = dict(Bucket=bucket, Prefix=key + "/")
                 if token:
                     kwargs["ContinuationToken"] = token
                 resp = client.list_objects_v2(**kwargs)
-                keys = [c["Key"] for c in resp.get("Contents", [])]
-                if keys and hasattr(client, "delete_objects"):
-                    for i in range(0, len(keys), 1000):
+            except Exception as e:  # noqa: BLE001
+                # without a continuation token we cannot advance; stop, but
+                # leave a trail so checkpoint-GC leaks are visible
+                _log.warning("rmtree(%s): list failed, sweep aborted: %s", path, e)
+                return
+            keys = [c["Key"] for c in resp.get("Contents", [])]
+            batched = keys and hasattr(client, "delete_objects")
+            for chunk in ([keys[i:i + 1000] for i in range(0, len(keys), 1000)]
+                          if batched else [[k] for k in keys]):
+                try:
+                    if batched:
                         client.delete_objects(
                             Bucket=bucket,
-                            Delete={"Objects": [{"Key": k} for k in keys[i:i + 1000]]},
-                        )
-                else:
-                    for k in keys:
-                        client.delete_object(Bucket=bucket, Key=k)
-                token = resp.get("NextContinuationToken")
-                if not token:
-                    break
-        except Exception:
-            pass
+                            Delete={"Objects": [{"Key": k} for k in chunk]})
+                    else:
+                        client.delete_object(Bucket=bucket, Key=chunk[0])
+                except Exception as e:  # noqa: BLE001
+                    # keep sweeping the remaining batches — one transient
+                    # failure must not abandon the whole prefix
+                    errors += 1
+                    if errors <= 3:
+                        _log.warning("rmtree(%s): delete batch failed: %s", path, e)
+            token = resp.get("NextContinuationToken")
+            if not token:
+                break
+        if errors:
+            _log.warning("rmtree(%s): %d delete batch(es) failed", path, errors)
         return
     shutil.rmtree(_local(path), ignore_errors=True)

@@ -66,18 +66,85 @@ INSERT INTO snk SELECT x, x * 2 AS d FROM src;
 
 
 def test_node_scheduler_requires_live_node(_storage):
+    """Placement without capacity must NOT block the (single-threaded)
+    supervision loop: start_worker returns a lazy handle that keeps
+    retrying from poll_events and reports failure at its deadline."""
     from arroyo_tpu.controller import Database
-    from arroyo_tpu.controller.scheduler import NodeScheduler
+    from arroyo_tpu.controller.scheduler import LazyNodeWorkerHandle, NodeScheduler
 
     db = Database()
-    with pytest.raises(RuntimeError, match="no live node"):
-        NodeScheduler(db).start_worker("SELECT 1", "j", 1, None)
+    t0 = time.monotonic()
+    h = NodeScheduler(db).start_worker("SELECT 1", "j", 1, None,
+                                       placement_timeout_s=0.3)
+    assert time.monotonic() - t0 < 1.0  # never busy-waits in start_worker
+    assert isinstance(h, LazyNodeWorkerHandle)
+    assert h.alive()
+    assert h.poll_events() == []  # still inside the placement window
+    time.sleep(0.35)
+    evs = h.poll_events()
+    assert any(e["event"] == "failed" and "no live node" in e["error"] for e in evs)
+    assert not h.alive()
+
     # stale heartbeat filtered out
     db.register_node("n1", "http://127.0.0.1:1", 4)
-    import arroyo_tpu.controller.db as dbm
-
     with db._lock:
         db._conn.execute("UPDATE nodes SET last_heartbeat=?", (time.time() - 3600,))
         db._conn.commit()
-    with pytest.raises(RuntimeError, match="no live node"):
-        NodeScheduler(db).start_worker("SELECT 1", "j", 1, None)
+    h2 = NodeScheduler(db).start_worker("SELECT 1", "j", 1, None,
+                                        placement_timeout_s=0.2)
+    assert isinstance(h2, LazyNodeWorkerHandle)
+    time.sleep(0.25)
+    evs = h2.poll_events()
+    assert any(e["event"] == "failed" and "no live node" in e["error"] for e in evs)
+
+
+def test_node_slot_reservation_released_on_spawn_failure(_storage):
+    """A failed worker spawn must release its under-lock reservation, and
+    concurrent reservations (value None) must count toward admission
+    without raising (ADVICE r4 medium, controller/node.py)."""
+    import urllib.error
+    import urllib.request
+
+    from arroyo_tpu.controller.node import NodeServer
+
+    node = NodeServer.__new__(NodeServer)  # no registration round-trip
+    node.slots = 1
+    node._workers = {}
+    import threading
+
+    node._lock = threading.Lock()
+
+    class H:
+        code = None
+        payload = None
+
+        def _body(self):
+            return {"job_id": "j"}  # missing "sql" -> KeyError in spawn
+
+        def _json(self, code, payload):
+            self.code, self.payload = code, payload
+
+    # in-flight reservation from another request: must count as used,
+    # not raise AttributeError on .alive()
+    node._workers["pending"] = None
+    h = H()
+    node._start_worker(h)
+    assert h.code == 409  # full: the reservation holds the only slot
+    node._workers.clear()
+
+    # spawn failure (bad body) must not leak the reservation
+    with pytest.raises(KeyError):
+        node._start_worker(H())
+    assert node._workers == {}
+
+    # stop() with an in-flight reservation must not raise
+    node._workers["pending"] = None
+    node._stop = threading.Event()
+
+    class _Httpd:
+        def shutdown(self):
+            pass
+
+    node.httpd = _Httpd()
+    node.stop()
+    assert node._workers == {}
