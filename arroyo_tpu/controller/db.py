@@ -29,6 +29,7 @@ CREATE TABLE IF NOT EXISTS jobs (
     pipeline_id TEXT NOT NULL REFERENCES pipelines(id),
     state TEXT NOT NULL,
     desired_stop TEXT,            -- NULL | 'checkpoint' | 'immediate'
+    desired_parallelism INTEGER,  -- non-NULL requests a live rescale
     restarts INTEGER NOT NULL DEFAULT 0,
     checkpoint_epoch INTEGER NOT NULL DEFAULT 0,
     restore_epoch INTEGER,
@@ -82,6 +83,14 @@ class Database:
         self._conn.row_factory = sqlite3.Row
         with self._lock:
             self._conn.executescript(_SCHEMA)
+            # additive migration for databases created by older builds
+            # (CREATE TABLE IF NOT EXISTS leaves existing tables untouched)
+            try:
+                self._conn.execute(
+                    "ALTER TABLE jobs ADD COLUMN desired_parallelism INTEGER")
+            except sqlite3.OperationalError as e:
+                if "duplicate column" not in str(e).lower():
+                    raise  # locked/readonly/corrupt db: fail loudly, not later
             self._conn.commit()
 
     # ------------------------------------------------------------ pipelines
@@ -108,6 +117,13 @@ class Database:
                 "SELECT * FROM pipelines ORDER BY created_at DESC"
             ).fetchall()
         return [dict(r) for r in rows]
+
+    def set_pipeline_parallelism(self, pid: str, parallelism: int) -> None:
+        """Persist a completed rescale so restarts keep the new scale."""
+        with self._lock:
+            self._conn.execute(
+                "UPDATE pipelines SET parallelism=? WHERE id=?", (parallelism, pid))
+            self._conn.commit()
 
     def delete_pipeline(self, pid: str) -> None:
         with self._lock:

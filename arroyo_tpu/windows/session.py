@@ -12,8 +12,11 @@ instead of buffering raw rows like the reference (whose DataFusion plans need
 them), we exploit that every supported aggregate (sum/count/min/max/avg) is
 mergeable: each batch is collapsed to provisional per-(key, run) partial
 accumulators with one vectorized sort + segment-reduce, and only those
-partials (a few per key per batch) hit the Python merge loop. Session merges
-combine accumulators, never rows.
+partials hit the session merge. The merge itself is array-resident too: open
+sessions live in parallel numpy columns (key, min_ts, max_ts, acc...) and
+gap-merging is one lexsort + segmented running-max scan per batch — no
+per-key Python objects, so key cardinality is bounded by memory, not by
+interpreter speed.
 """
 
 from __future__ import annotations
@@ -22,7 +25,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch
+from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch, object_column
 from ..engine.engine import register_operator
 from ..expr import eval_expr
 from ..graph import OpName
@@ -30,24 +33,30 @@ from ..operators.base import Operator, TableSpec
 from ..types import Watermark
 from .tumbling import WINDOW_END, WINDOW_START, acc_plan, dtype_of_from_config
 
-
-def _combine(kind: str, a, b):
-    if kind in ("sum", "count"):
-        return a + b
-    if kind == "collect":  # UDAF state: collected values
-        return list(a) + list(b)
-    if kind == "min":
-        return min(a, b)
-    return max(a, b)
+# base for the exclusive running max: low enough that +gap never overflows
+_REACH_MIN = np.iinfo(np.int64).min // 4
 
 
-class _Session:
-    __slots__ = ("min_ts", "max_ts", "accs")
-
-    def __init__(self, min_ts: int, max_ts: int, accs: list):
-        self.min_ts = min_ts
-        self.max_ts = max_ts
-        self.accs = accs
+def _seg_cummax_excl(seg_new: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    """Exclusive segmented running max: out[i] = max(vals[j]) over j < i
+    within i's segment (segments start where seg_new is True); _REACH_MIN at
+    segment starts. Hillis-Steele segmented scan — O(n log n) in vectorized
+    passes, no Python per-element work."""
+    n = len(vals)
+    out = np.empty(n, dtype=np.int64)
+    out[0] = _REACH_MIN
+    if n > 1:
+        out[1:] = np.where(seg_new[1:], _REACH_MIN, vals[:-1])
+    flag = seg_new.copy()
+    d = 1
+    while d < n:
+        nxt = out.copy()
+        np.maximum(out[d:], out[:-d], out=nxt[d:], where=~flag[d:])
+        nflag = flag.copy()
+        nflag[d:] |= flag[:-d]
+        out, flag = nxt, nflag
+        d *= 2
+    return out
 
 
 class SessionAggregate(Operator):
@@ -61,9 +70,13 @@ class SessionAggregate(Operator):
         self.final_projection = cfg.get("final_projection")
         dtype_of = dtype_of_from_config(cfg)
         self.acc_kinds, self.acc_dtypes, self.acc_inputs = acc_plan(self.aggregates, dtype_of)
-        # key-hash -> sorted-by-min_ts list of open sessions
-        self.sessions: dict[int, list[_Session]] = {}
-        self.key_values: dict[int, tuple] = {}
+        # open sessions as parallel columns (sorted within each merge group)
+        self.s_key = np.empty(0, dtype=np.int64)   # signed view of routing hash
+        self.s_min = np.empty(0, dtype=np.int64)
+        self.s_max = np.empty(0, dtype=np.int64)
+        self.s_accs: list[np.ndarray] = [np.empty(0, dtype=d) for d in self.acc_dtypes]
+        # per-key-field value columns; created lazily with the input's dtype
+        self.s_keycols: Optional[list[np.ndarray]] = None
         self.emitted_watermark: Optional[int] = None
         self.late_rows = 0
 
@@ -95,48 +108,67 @@ class SessionAggregate(Operator):
             self.emitted_watermark = max(wms)
 
     def _restore_from_batch(self, b: Batch) -> None:
-        # session dict keys are the SIGNED view of the routing hash (matching
-        # process_batch's lexsort path)
-        hashes = b.keys.astype(np.uint64).view(np.int64)
-        key_cols = [b[f] for f in self.key_fields]
-        for j in range(b.num_rows):
-            h = int(hashes[j])
-            accs = [list(b[f"__acc_{i}"][j]) if self.acc_kinds[i] == "collect"
-                    else d.type(b[f"__acc_{i}"][j])
-                    for i, d in enumerate(self.acc_dtypes)]
-            self._merge_session(
-                h, int(b["__min_ts"][j]), int(b["__max_ts"][j]), accs
-            )
-            if self.key_fields and h not in self.key_values:
-                self.key_values[h] = tuple(c[j] for c in key_cols)
+        # session columns use the SIGNED view of the routing hash (matching
+        # process_batch's lexsort path); rescale restore can bring the same
+        # key's sessions from several prior subtasks -> coalesce merges them
+        key = b.keys.astype(np.uint64).view(np.int64)
+        accs = []
+        for i, d in enumerate(self.acc_dtypes):
+            col = b[f"__acc_{i}"]
+            if self.acc_kinds[i] == "collect":
+                accs.append(object_column(list(v) for v in col))
+            else:
+                accs.append(np.asarray(col).astype(d, copy=True))
+        keycols = [np.asarray(b[f]).copy() for f in self.key_fields]
+        (self.s_key, self.s_min, self.s_max, self.s_accs, kc) = self._coalesce(
+            key, np.asarray(b["__min_ts"], dtype=np.int64),
+            np.asarray(b["__max_ts"], dtype=np.int64), accs, keycols)
+        self.s_keycols = kc if self.key_fields else []
 
     # ------------------------------------------------------------------
 
-    def _merge_session(self, h: int, min_ts: int, max_ts: int, accs: list) -> None:
-        """Insert [min_ts, max_ts] into key h's session list, merging every
-        existing session within ``gap`` of it."""
-        lst = self.sessions.get(h)
-        if lst is None:
-            self.sessions[h] = [_Session(min_ts, max_ts, accs)]
-            return
-        merged_min, merged_max, merged_accs = min_ts, max_ts, accs
-        kept: list[_Session] = []
-        for s in lst:
-            if s.max_ts + self.gap >= merged_min and s.min_ts - self.gap <= merged_max:
-                merged_min = min(merged_min, s.min_ts)
-                merged_max = max(merged_max, s.max_ts)
-                merged_accs = [
-                    _combine(k, a, b)
-                    for k, a, b in zip(self.acc_kinds, merged_accs, s.accs)
-                ]
+    def _coalesce(self, key, mn, mx, accs, keycols):
+        """Gap-merge candidate sessions (existing + new runs): one lexsort
+        by (key, min_ts), an exclusive segmented running max of max_ts, and
+        segment reduces for the accumulators."""
+        order = np.lexsort((mn, key))
+        key, mn, mx = key[order], mn[order], mx[order]
+        n = len(key)
+        seg_new = np.empty(n, dtype=bool)
+        seg_new[0] = True
+        seg_new[1:] = key[1:] != key[:-1]
+        reach = _seg_cummax_excl(seg_new, mx)
+        starts_new = seg_new | (mn > reach + self.gap)
+        g0 = np.flatnonzero(starts_new)
+        out_accs = []
+        for kind, a in zip(self.acc_kinds, accs):
+            a = a[order]
+            if kind == "collect":
+                ends = np.append(g0[1:], n)
+                merged = []
+                for s, e in zip(g0, ends):
+                    if e - s == 1:
+                        merged.append(a[s])
+                    else:
+                        acc: list = []
+                        for lst in a[s:e]:
+                            acc.extend(lst)
+                        merged.append(acc)
+                out_accs.append(object_column(merged))
+            elif kind in ("sum", "count"):
+                out_accs.append(np.add.reduceat(a, g0))
+            elif kind == "min":
+                out_accs.append(np.minimum.reduceat(a, g0))
             else:
-                kept.append(s)
-        kept.append(_Session(merged_min, merged_max, merged_accs))
-        kept.sort(key=lambda s: s.min_ts)
-        self.sessions[h] = kept
+                out_accs.append(np.maximum.reduceat(a, g0))
+        # sorted by min_ts within each key: the group start holds the min
+        return (key[g0], mn[g0], np.maximum.reduceat(mx, g0), out_accs,
+                [c[order][g0] for c in keycols])
 
     def process_batch(self, batch, ctx, collector, input_index=0):
         n = batch.num_rows
+        if n == 0:
+            return
         ts = batch.timestamps
         if self.emitted_watermark is not None:
             # a row re-opens an already-emitted session iff the session it
@@ -164,11 +196,12 @@ class SessionAggregate(Operator):
         starts = np.flatnonzero(brk)
         ends = np.append(starts[1:], n)
         # per-accumulator values, segment-reduced per provisional run
-        vals = []
+        run_accs: list[np.ndarray] = []
         for inp, dt, kind in zip(self.acc_inputs, self.acc_dtypes, self.acc_kinds):
             if kind == "collect":
                 v = np.asarray(eval_expr(inp, batch.columns, n))[order]
-                vals.append([v[si:ei].tolist() for si, ei in zip(starts, ends)])
+                run_accs.append(object_column(
+                    v[si:ei].tolist() for si, ei in zip(starts, ends)))
                 continue
             if inp is None:
                 v = np.ones(n, dtype=dt)
@@ -176,22 +209,51 @@ class SessionAggregate(Operator):
                 v = np.asarray(eval_expr(inp, batch.columns, n)).astype(dt)
             v = v[order]
             if kind in ("sum", "count"):
-                vals.append(np.add.reduceat(v, starts))
+                run_accs.append(np.add.reduceat(v, starts))
             elif kind == "min":
-                vals.append(np.minimum.reduceat(v, starts))
+                run_accs.append(np.minimum.reduceat(v, starts))
             else:
-                vals.append(np.maximum.reduceat(v, starts))
-        if self.key_fields:
-            cols = [np.asarray(batch[f])[order] for f in self.key_fields]
-            for si in starts:
-                h = int(k_s[si])
-                if h not in self.key_values:
-                    self.key_values[h] = tuple(c[si] for c in cols)
-        for i, (si, ei) in enumerate(zip(starts, ends)):
-            accs = [vals[j][i] if self.acc_kinds[j] == "collect"
-                    else self.acc_dtypes[j].type(vals[j][i])
-                    for j in range(len(vals))]
-            self._merge_session(int(k_s[si]), int(t_s[si]), int(t_s[ei - 1]), accs)
+                run_accs.append(np.maximum.reduceat(v, starts))
+        run_keycols = [np.asarray(batch[f])[order][starts] for f in self.key_fields]
+        run_key, run_min, run_max = k_s[starts], t_s[starts], t_s[ends - 1]
+        self._merge_runs(run_key, run_min, run_max, run_accs, run_keycols)
+
+    def _merge_runs(self, r_key, r_min, r_max, r_accs, r_keycols) -> None:
+        if self.s_keycols is None:
+            self.s_keycols = [c[:0] for c in r_keycols]
+        if len(self.s_key) == 0:
+            # runs from one batch are already gap-separated per key
+            self.s_key, self.s_min, self.s_max = r_key, r_min, r_max
+            self.s_accs, self.s_keycols = list(r_accs), list(r_keycols)
+            return
+        # only sessions whose key appears in this batch can merge; leave the
+        # (potentially much larger) untouched remainder alone
+        touched = np.isin(self.s_key, r_key)
+        if touched.any():
+            t = touched
+            key = np.concatenate([self.s_key[t], r_key])
+            mn = np.concatenate([self.s_min[t], r_min])
+            mx = np.concatenate([self.s_max[t], r_max])
+            accs = [np.concatenate([sa[t], ra]) for sa, ra in zip(self.s_accs, r_accs)]
+            kcs = [np.concatenate([sc[t], rc])
+                   for sc, rc in zip(self.s_keycols, r_keycols)]
+            m_key, m_min, m_max, m_accs, m_kcs = self._coalesce(key, mn, mx, accs, kcs)
+            keep = ~touched
+            self.s_key = np.concatenate([self.s_key[keep], m_key])
+            self.s_min = np.concatenate([self.s_min[keep], m_min])
+            self.s_max = np.concatenate([self.s_max[keep], m_max])
+            self.s_accs = [np.concatenate([sa[keep], ma])
+                           for sa, ma in zip(self.s_accs, m_accs)]
+            self.s_keycols = [np.concatenate([sc[keep], mc])
+                              for sc, mc in zip(self.s_keycols, m_kcs)]
+        else:
+            self.s_key = np.concatenate([self.s_key, r_key])
+            self.s_min = np.concatenate([self.s_min, r_min])
+            self.s_max = np.concatenate([self.s_max, r_max])
+            self.s_accs = [np.concatenate([sa, ra])
+                           for sa, ra in zip(self.s_accs, r_accs)]
+            self.s_keycols = [np.concatenate([sc, rc])
+                              for sc, rc in zip(self.s_keycols, r_keycols)]
 
     # ------------------------------------------------------------------
 
@@ -204,67 +266,45 @@ class SessionAggregate(Operator):
         # sessions may hold arbitrarily old starts, and brand-new sessions
         # can begin at ts > w - gap; forward the lower bound (see tumbling)
         held = watermark.value - self.gap
-        for lst in self.sessions.values():
-            for s in lst:
-                if s.min_ts < held:
-                    held = s.min_ts
+        if len(self.s_min):
+            held = min(held, int(self.s_min.min()))
         return Watermark.event_time(held)
 
     def on_close(self, ctx, collector):
         self._emit_closed(None, collector)
 
     def _emit_closed(self, watermark: Optional[int], collector) -> None:
-        rows: list[tuple[int, _Session]] = []
-        dead_keys = []
-        for h, lst in self.sessions.items():
-            if watermark is None:
-                closed, kept = lst, []
-            else:
-                closed = [s for s in lst if s.max_ts + self.gap <= watermark]
-                kept = [s for s in lst if s.max_ts + self.gap > watermark]
-            rows.extend((h, s) for s in closed)
-            if kept:
-                self.sessions[h] = kept
-            else:
-                dead_keys.append(h)
-        if rows:
-            self._emit_rows(rows, collector)
-        for h in dead_keys:
-            del self.sessions[h]
-            self.key_values.pop(h, None)
+        if len(self.s_key) == 0:
+            return
+        if watermark is None:
+            closed = np.ones(len(self.s_key), dtype=bool)
+        else:
+            closed = self.s_max + self.gap <= watermark
+        if not closed.any():
+            return
+        self._emit_rows(closed, collector)
+        keep = ~closed
+        self.s_key, self.s_min, self.s_max = (
+            self.s_key[keep], self.s_min[keep], self.s_max[keep])
+        self.s_accs = [a[keep] for a in self.s_accs]
+        self.s_keycols = [c[keep] for c in self.s_keycols]
 
-    def _emit_rows(self, rows, collector) -> None:
+    def _emit_rows(self, closed: np.ndarray, collector) -> None:
         from ..ops.aggregate import finalize_aggs
 
-        n = len(rows)
-        starts = np.array([s.min_ts for _h, s in rows], dtype=np.int64)
-        ends = np.array([s.max_ts + self.gap for _h, s in rows], dtype=np.int64)
+        mn, mx, key = self.s_min[closed], self.s_max[closed], self.s_key[closed]
+        # deterministic emission order: by (window_start, key); one fused
+        # gather index instead of mask-then-permute per column
+        idx = np.flatnonzero(closed)[np.lexsort((key, mn))]
+        starts = self.s_min[idx]
+        n = len(starts)
         cols: dict[str, np.ndarray] = {}
-        if self.key_fields:
-            for j, f in enumerate(self.key_fields):
-                sample = next(
-                    (self.key_values[h][j] for h, _s in rows if h in self.key_values),
-                    None,
-                )
-                vals = [
-                    self.key_values.get(h, (None,) * len(self.key_fields))[j]
-                    for h, _s in rows
-                ]
-                if isinstance(sample, (str, type(None))):
-                    cols[f] = np.array(vals, dtype=object)
-                else:
-                    cols[f] = np.array(vals)
+        for f, c in zip(self.key_fields, self.s_keycols):
+            cols[f] = c[idx]
         cols[WINDOW_START] = starts
-        cols[WINDOW_END] = ends
-        from ..batch import object_column
-
-        acc_arrays = [
-            object_column(s.accs[i] for _h, s in rows)
-            if self.acc_kinds[i] == "collect"
-            else np.array([s.accs[i] for _h, s in rows], dtype=d)
-            for i, d in enumerate(self.acc_dtypes)
-        ]
-        finals = finalize_aggs([a[1] for a in self.aggregates], acc_arrays)
+        cols[WINDOW_END] = self.s_max[idx] + self.gap
+        finals = finalize_aggs([a[1] for a in self.aggregates],
+                               [a[idx] for a in self.s_accs])
         for (name, _k, _e), arr in zip(self.aggregates, finals):
             cols[name] = arr
         cols[TIMESTAMP_FIELD] = starts
@@ -286,35 +326,23 @@ class SessionAggregate(Operator):
             {"emitted_watermark": self.emitted_watermark},
         )
         tbl = ctx.table_manager.expiring_time_key("s", self.gap)
-        items = [(h, s) for h, lst in self.sessions.items() for s in lst]
-        if not items:
+        n = len(self.s_key)
+        if n == 0:
             tbl.replace_all([])
             return
-        n = len(items)
         cols: dict[str, np.ndarray] = {
-            TIMESTAMP_FIELD: np.array([s.max_ts for _h, s in items], dtype=np.int64),
-            KEY_FIELD: np.array([h for h, _s in items], dtype=np.int64).view(np.uint64),
-            "__min_ts": np.array([s.min_ts for _h, s in items], dtype=np.int64),
-            "__max_ts": np.array([s.max_ts for _h, s in items], dtype=np.int64),
+            TIMESTAMP_FIELD: self.s_max.copy(),
+            KEY_FIELD: self.s_key.view(np.uint64).copy(),
+            "__min_ts": self.s_min.copy(),
+            "__max_ts": self.s_max.copy(),
         }
-        from ..batch import object_column
-
-        for i, d in enumerate(self.acc_dtypes):
-            if self.acc_kinds[i] == "collect":
-                cols[f"__acc_{i}"] = object_column(list(s.accs[i]) for _h, s in items)
+        for i, kind in enumerate(self.acc_kinds):
+            if kind == "collect":
+                cols[f"__acc_{i}"] = object_column(list(v) for v in self.s_accs[i])
             else:
-                cols[f"__acc_{i}"] = np.array([s.accs[i] for _h, s in items], dtype=d)
-        if self.key_fields:
-            for j, f in enumerate(self.key_fields):
-                vals = [
-                    self.key_values.get(h, (None,) * len(self.key_fields))[j]
-                    for h, _s in items
-                ]
-                sample = next((v for v in vals if v is not None), None)
-                if isinstance(sample, (str, type(None))):
-                    cols[f] = np.array(vals, dtype=object)
-                else:
-                    cols[f] = np.array(vals)
+                cols[f"__acc_{i}"] = self.s_accs[i].copy()
+        for f, c in zip(self.key_fields, self.s_keycols):
+            cols[f] = c.copy()
         tbl.replace_all([Batch(cols)])
 
 
