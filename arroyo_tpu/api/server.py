@@ -282,6 +282,24 @@ class ApiServer:
             self.db.update_job(jid, state="Restarting", desired_stop=None)
             h._json(200, {"id": jid, "state": "Restarting"})
             return
+        if "parallelism" in body:
+            # live rescale (reference jobs.rs parallelism patch +
+            # states/rescaling.rs): the controller checkpoints-and-stops the
+            # running worker, then reschedules at the new parallelism
+            want = body["parallelism"]
+            # bool is an int subclass; floats must not silently truncate
+            if isinstance(want, bool) or not isinstance(want, int):
+                h._json(400, {"error": "parallelism must be an integer"})
+                return
+            if want < 1:
+                h._json(400, {"error": "parallelism must be >= 1"})
+                return
+            if j["state"] not in ("Running", "Scheduling", "Created", "Compiling"):
+                h._json(409, {"error": f"cannot rescale a {j['state']} job"})
+                return
+            self.db.update_job(jid, desired_parallelism=want)
+            h._json(200, {"id": jid, "desired_parallelism": want})
+            return
         stop = body.get("stop")
         if stop not in ("checkpoint", "immediate"):
             h._json(400, {"error": "stop must be 'checkpoint' or 'immediate'"})

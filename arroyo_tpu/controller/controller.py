@@ -40,6 +40,7 @@ class JobController:
         self.last_checkpoint_time = time.monotonic()
         self.running_since: Optional[float] = None
         self.stopping_epoch: Optional[int] = None
+        self.rescale_to: Optional[int] = None
         self.failure: Optional[str] = None
         from ..metrics import RateTracker
 
@@ -87,8 +88,18 @@ class JobController:
             self._schedule(job)
         elif self.state in (JobState.RUNNING, JobState.CHECKPOINT_STOPPING,
                             JobState.STOPPING, JobState.FINISHING):
-            self._supervise(desired_stop)
-        elif self.state in (JobState.RECOVERING, JobState.RESTARTING, JobState.RESCALING):
+            self._supervise(desired_stop, job)
+        elif self.state == JobState.RESCALING:
+            # the old worker is draining behind a final checkpoint; keep
+            # supervising it — _supervise's finished/failed handlers do the
+            # actual Rescaling -> Scheduling hop (reference rescaling.rs:16)
+            if self.handle is not None:
+                self._supervise(desired_stop, job)
+            else:
+                # adopted mid-rescale by a fresh controller: treat like a
+                # restart at the (already persisted) new parallelism
+                self._finish_rescale(job)
+        elif self.state in (JobState.RECOVERING, JobState.RESTARTING):
             restarts_allowed = config().get("pipeline.allowed-restarts")
             if self.state == JobState.RECOVERING and self.restarts > restarts_allowed:
                 self._fail(f"exceeded allowed-restarts={restarts_allowed}: {self.failure}")
@@ -96,6 +107,25 @@ class JobController:
             self.restore_epoch = latest_complete_checkpoint(self.storage_url, self.job_id)
             self._set_state(JobState.SCHEDULING, restarts=self.restarts,
                             restore_epoch=self.restore_epoch)
+
+    def _finish_rescale(self, job: dict) -> None:
+        """Old worker is gone; restore from the freshest checkpoint at the
+        new parallelism (the state layer rescales via key-range-overlap
+        file reads on restore)."""
+        # re-read the request: the API may have accepted a NEWER target
+        # after this drain was triggered — honor the freshest value
+        fresh = self.db.get_job(self.job_id) or job
+        target = fresh.get("desired_parallelism") or self.rescale_to
+        self.rescale_to = None
+        if target:
+            self.parallelism = int(target)
+            self.db.set_pipeline_parallelism(job["pipeline_id"], int(target))
+            # conditional clear: a request racing in after the re-read
+            # above survives and triggers a follow-up rescale
+            self.db.clear_desired_parallelism(self.job_id, int(target))
+        self.restore_epoch = latest_complete_checkpoint(self.storage_url, self.job_id)
+        self._set_state(JobState.SCHEDULING, restore_epoch=self.restore_epoch,
+                        restarts=self.restarts)
 
     # ------------------------------------------------------------------
 
@@ -108,6 +138,13 @@ class JobController:
             return
         self.sql = pipeline["query"]
         self.parallelism = int(pipeline["parallelism"])
+        # a rescale accepted before the job ever ran starts the worker at
+        # the new scale directly — no wasted drain cycle after Running
+        want = job.get("desired_parallelism")
+        if want:
+            self.parallelism = int(want)
+            self.db.set_pipeline_parallelism(job["pipeline_id"], int(want))
+            self.db.clear_desired_parallelism(self.job_id, int(want))
         plan_query(self.sql)  # validate; workers re-plan themselves
         self._set_state(JobState.SCHEDULING)
 
@@ -154,7 +191,7 @@ class JobController:
             self.next_epoch = self.restore_epoch + 1
         self._set_state(JobState.RUNNING)
 
-    def _supervise(self, desired_stop: Optional[str]) -> None:
+    def _supervise(self, desired_stop: Optional[str], job: dict) -> None:
         assert self.handle is not None
         cfgv = config()
         # healthy-duration resets the restart budget (default.toml:8 analog)
@@ -185,6 +222,14 @@ class JobController:
                 if self.state == JobState.CHECKPOINT_STOPPING and epoch == self.stopping_epoch:
                     self._set_state(JobState.STOPPING)
             elif kind == "finished":
+                if self.state == JobState.RESCALING:
+                    try:
+                        self.handle.kill()
+                    except Exception:
+                        pass
+                    self.handle = None
+                    self._finish_rescale(job)
+                    return
                 if self.state == JobState.STOPPING or self.state == JobState.CHECKPOINT_STOPPING:
                     self._set_state(JobState.STOPPED)
                 else:
@@ -203,7 +248,11 @@ class JobController:
                 self.handle.kill()
                 self.handle = None
                 self.restarts += 1
-                if self.state in (JobState.STOPPING, JobState.CHECKPOINT_STOPPING):
+                if self.state == JobState.RESCALING:
+                    # drain failed mid-rescale: still proceed to the new
+                    # parallelism from whatever checkpoint exists
+                    self._finish_rescale(job)
+                elif self.state in (JobState.STOPPING, JobState.CHECKPOINT_STOPPING):
                     self._set_state(JobState.STOPPED)
                 else:
                     self._set_state(JobState.RECOVERING,
@@ -219,19 +268,40 @@ class JobController:
             self.handle.kill()
             self.handle = None
             self.restarts += 1
-            self._set_state(JobState.RECOVERING, failure_message=self.failure)
+            if self.state == JobState.RESCALING:
+                # old worker died draining: rescale from the last checkpoint
+                self._finish_rescale(job)
+            else:
+                self._set_state(JobState.RECOVERING, failure_message=self.failure)
             return
 
-        # stop requests from the API
+        # rescale requests from the API (reference states/rescaling.rs:1-70):
+        # checkpoint-and-stop the old worker, then reschedule at the new
+        # parallelism restoring from that final checkpoint
+        if self.state == JobState.RUNNING and not desired_stop:
+            want = job.get("desired_parallelism")
+            if want and int(want) != self.parallelism:
+                self.rescale_to = int(want)
+                self.stopping_epoch = self.next_epoch
+                self.next_epoch += 1
+                self.handle.trigger_checkpoint(self.stopping_epoch, then_stop=True)
+                self._set_state(JobState.RESCALING)
+                return
+            if want and int(want) == self.parallelism:
+                # no-op rescale: clear the request
+                self.db.update_job(self.job_id, desired_parallelism=None)
+
+        # stop requests from the API; a stop also voids any pending rescale
+        # so it cannot resurrect as a surprise drain cycle at a later restart
         if self.state == JobState.RUNNING and desired_stop:
             if desired_stop == "checkpoint":
                 self.stopping_epoch = self.next_epoch
                 self.next_epoch += 1
                 self.handle.trigger_checkpoint(self.stopping_epoch, then_stop=True)
-                self._set_state(JobState.CHECKPOINT_STOPPING)
+                self._set_state(JobState.CHECKPOINT_STOPPING, desired_parallelism=None)
             else:
                 self.handle.stop()
-                self._set_state(JobState.STOPPING)
+                self._set_state(JobState.STOPPING, desired_parallelism=None)
             return
 
         # periodic checkpoints (reference default-checkpoint-interval)

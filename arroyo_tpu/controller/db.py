@@ -161,6 +161,16 @@ class Database:
                 ).fetchall()
         return [dict(r) for r in rows]
 
+    def clear_desired_parallelism(self, jid: str, expected: int) -> None:
+        """Clear the rescale request iff it still holds the value we just
+        applied; a newer concurrent request survives to trigger again."""
+        with self._lock:
+            self._conn.execute(
+                "UPDATE jobs SET desired_parallelism=NULL, updated_at=? "
+                "WHERE id=? AND desired_parallelism=?",
+                (time.time(), jid, expected))
+            self._conn.commit()
+
     def update_job(self, jid: str, **fields: Any) -> None:
         if not fields:
             return

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import os
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -138,6 +139,67 @@ def test_process_scheduler_crash_recovery(tmp_path, _storage):
         os.environ.pop("ARROYO_TPU__CHECKPOINT__STORAGE_URL", None)
         cfg.update({"checkpoint.interval-ms": 10_000})
         ctl.stop()
+
+
+def test_live_rescale_midstream(tmp_path, _storage):
+    """PATCH parallelism on a running job: controller drains the worker
+    behind a final checkpoint (Running -> Rescaling), reschedules at the
+    new parallelism restoring from it, and output parity holds
+    (reference states/rescaling.rs:1-70 + jobs.rs parallelism patch)."""
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.api import ApiServer
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    cfg.update({"testing.source-read-delay-micros": 4000,
+                "checkpoint.interval-ms": 150})
+    api = ApiServer(db, port=0).start()
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+
+    def req(method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{api.port}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(r) as resp:
+            return json.loads(resp.read())
+
+    try:
+        pid = db.create_pipeline("agg", sql, 2)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        time.sleep(0.3)  # let some input flow at p=2
+        resp = req("PATCH", f"/api/v1/jobs/{jid}", {"parallelism": 3})
+        assert resp["desired_parallelism"] == 3
+        # the job must pass through Rescaling on its way back to Running
+        seen = set()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            seen.add(db.get_job(jid)["state"])
+            if "Rescaling" in seen and db.get_job(jid)["state"] == "Running":
+                break
+            time.sleep(0.01)
+        assert "Rescaling" in seen, f"states seen: {seen}"
+        assert ctl.jobs[jid].parallelism == 3
+        # the rescale restored from the drain checkpoint, not from scratch
+        assert ctl.jobs[jid].restore_epoch is not None
+        cfg.update({"testing.source-read-delay-micros": 0})
+        state = ctl.wait_for_state(jid, "Finished", timeout=120)
+        assert state == "Finished"
+        _assert_golden(out)
+        # the new scale is persisted for future restarts
+        assert db.get_pipeline(pid)["parallelism"] == 3
+        assert db.get_job(jid)["desired_parallelism"] is None
+        # rescaling a terminal job is rejected
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req("PATCH", f"/api/v1/jobs/{jid}", {"parallelism": 2})
+        assert ei.value.code == 409
+    finally:
+        cfg.update({"testing.source-read-delay-micros": 0,
+                    "checkpoint.interval-ms": 10_000})
+        ctl.stop()
+        api.stop()
 
 
 def test_rest_api_lifecycle(tmp_path, _storage):
