@@ -131,8 +131,22 @@ class BinOp(Expr):
         r = self.right.eval_np(cols, n)
         if self.op == "/":
             return _div(l, r)
-        if self.op in ("==", "!=") and (_is_str(l) or _is_str(r)):
-            l, r = _as_obj(l, n), _as_obj(r, n)
+        if self.op in ("==", "!=", "<", "<=", ">", ">=") and (
+                _is_str(l) or _is_str(r)):
+            # object operands (strings / outer-join null padding): SQL
+            # three-valued logic — a NULL on either side compares as
+            # unknown, which filters as False, for EVERY comparison op
+            lo, ro = _as_obj(l, n), _as_obj(r, n)
+            null = _null_mask(lo) | _null_mask(ro)
+            if null.any():
+                out = np.zeros(n, dtype=bool)
+                ok = ~null
+                if ok.any():
+                    fn = _NP_BINOPS[self.op]
+                    out[ok] = np.array(
+                        [bool(fn(a, b)) for a, b in zip(lo[ok], ro[ok])])
+                return out
+            l, r = lo, ro
         return _NP_BINOPS[self.op](l, r)
 
     def eval_jnp(self, cols):
@@ -203,13 +217,20 @@ class Cast(Expr):
         v = self.inner.eval_np(cols, n)
         if self.dtype == "string":
             v = np.asarray(v) if hasattr(v, "dtype") else np.full(n, v)
-            return np.array([str(x) for x in v], dtype=object)
+            # CAST(NULL AS TEXT) is NULL, not 'None'
+            return np.array([None if x is None else str(x) for x in v],
+                            dtype=object)
         target = {"int32": np.int32, "int64": np.int64, "uint64": np.uint64,
                   "float32": np.float32, "float64": np.float64, "bool": np.bool_}[self.dtype]
         if hasattr(v, "dtype") and v.dtype == object:
-            if target in (np.float32, np.float64):
-                return np.array([float(x) for x in v], dtype=target)
-            return np.array([int(x) for x in v], dtype=target)
+            conv = float if target in (np.float32, np.float64) else int
+            vals = [None if x is None else conv(x) for x in v]
+            if any(x is None for x in vals):
+                # nulls survive the cast (outer-join padding): stay object
+                out = np.empty(len(vals), dtype=object)
+                out[:] = vals
+                return out
+            return np.array(vals, dtype=target)
         return np.asarray(v).astype(target) if hasattr(v, "dtype") else target(v)
 
     def eval_jnp(self, cols):
@@ -363,6 +384,38 @@ class Func(Expr):
                 [bool(rx.match(s)) if s is not None else False for s in vals],
                 dtype=bool,
             )
+        if name in ("json_get", "json_get_str"):
+            # -> / ->> accessors (reference arroyo-planner json functions):
+            # json_get yields the accessed value re-serialized as JSON text
+            # ("155", "\"pickup\"", "null"); json_get_str yields bare text
+            # (None for missing/null)
+            import json as _json
+
+            keys = a[1]
+            key_is_scalar = _is_scalar(keys)
+            docs = _as_obj(a[0], n)
+            out = np.empty(n, dtype=object)
+            for i, doc in enumerate(docs):
+                k = keys if key_is_scalar else keys[i]
+                v = None
+                if doc is not None:
+                    try:
+                        parsed = _json.loads(doc) if isinstance(doc, (str, bytes)) else doc
+                    except (ValueError, TypeError):
+                        parsed = None
+                    if isinstance(parsed, dict):
+                        v = parsed.get(k)
+                    elif isinstance(parsed, list):
+                        try:
+                            v = parsed[int(k)]
+                        except (IndexError, ValueError, TypeError):
+                            v = None
+                if name == "json_get":
+                    out[i] = _json.dumps(v, separators=(",", ":"))
+                else:
+                    out[i] = None if v is None else (
+                        v if isinstance(v, str) else _json.dumps(v, separators=(",", ":")))
+            return out
         raise NotImplementedError(f"scalar function {name}")
 
     def eval_jnp(self, cols):

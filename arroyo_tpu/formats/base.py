@@ -116,4 +116,9 @@ def rows_to_batch(
         now = int(time.time() * 1e6)
         ts = [r.get(TIMESTAMP_FIELD, now) for r in rows]
         cols[TIMESTAMP_FIELD] = np.array(ts, dtype=np.int64)
+    # debezium rows carry the retract flag through to the batch (reference
+    # de.rs debezium -> _updating_meta.is_retract); absent for append formats
+    if rows and "_is_retract" in rows[0]:
+        cols["_is_retract"] = np.array(
+            [bool(r.get("_is_retract", False)) for r in rows], dtype=np.bool_)
     return Batch(cols)

@@ -35,6 +35,7 @@ class OpName(enum.Enum):
     LOOKUP_JOIN = "lookup_join"
     WINDOW_FUNCTION = "window_function"  # SQL OVER
     ASYNC_UDF = "async_udf"
+    UNNEST = "unnest"  # array explode (reference UnnestRewriter, rewriters.rs:323)
     CHAINED = "chained"  # fused run of operators (optimizers.rs:40 analog)
 
 

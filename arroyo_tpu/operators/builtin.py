@@ -59,6 +59,45 @@ class ValueOperator(Operator):
         collector.collect(Batch(cols))
 
 
+class UnnestOperator(Operator):
+    """config: column (list-valued), out_name, out_dtype. Explodes each
+    row's list into one output row per element; all other columns repeat.
+    Rows with empty lists vanish (reference UnnestRewriter semantics,
+    rewriters.rs:323 / datafusion unnest)."""
+
+    def __init__(self, cfg: dict):
+        self.column = str(cfg["column"])
+        self.out_name = str(cfg.get("out_name", self.column))
+        self.out_dtype = cfg.get("out_dtype")
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        import itertools
+
+        col = batch.columns[self.column]
+        # UNNEST of a NULL array produces zero rows for that input row
+        lens = np.fromiter((0 if v is None else len(v) for v in col),
+                           dtype=np.int64, count=batch.num_rows)
+        total = int(lens.sum())
+        if total == 0:
+            return
+        flat = list(itertools.chain.from_iterable(v for v in col if v is not None))
+        cols: dict[str, np.ndarray] = {}
+        for name, c in batch.columns.items():
+            if name == self.column:
+                continue
+            cols[name] = np.repeat(np.asarray(c), lens)
+        if self.out_dtype and self.out_dtype != "string":
+            from ..batch import Field
+
+            vals = np.array(flat, dtype=Field("_", self.out_dtype).numpy_dtype())
+        else:
+            from ..batch import object_column
+
+            vals = object_column(flat)
+        cols[self.out_name] = vals
+        collector.collect(Batch(cols))
+
+
 class KeyOperator(Operator):
     """config: keys: list[(name, Expr)] — computes group-by columns and the
     uint64 routing hash (_key)."""
@@ -156,6 +195,11 @@ def _make_value(cfg: dict):
 @register_operator(OpName.KEY)
 def _make_key(cfg: dict):
     return KeyOperator(cfg)
+
+
+@register_operator(OpName.UNNEST)
+def _make_unnest(cfg: dict):
+    return UnnestOperator(cfg)
 
 
 @register_operator(OpName.WATERMARK)

@@ -33,7 +33,7 @@ from .ast import (
 )
 from .lexer import SqlError
 
-AGG_FUNCS = {"count", "sum", "min", "max", "avg"}
+AGG_FUNCS = {"count", "sum", "min", "max", "avg", "array_agg"}
 
 
 def _is_udaf(name: str) -> bool:
@@ -66,6 +66,7 @@ _SQL_TYPES = {
     "CHAR": "string",
     "CHARACTER VARYING": "string",
     "STRING": "string",
+    "JSON": "string",  # raw JSON text column (reference json type)
     "TIMESTAMP": "timestamp",
     "TIMESTAMPTZ": "timestamp",
     "DATE": "timestamp",
@@ -190,6 +191,11 @@ def compile_expr(e: SqlExpr, scope: Scope) -> Expr:
     if isinstance(e, BinaryOp):
         if e.op == "||":
             return Func("concat", (compile_expr(e.left, scope), compile_expr(e.right, scope)))
+        if e.op in ("->", "->>"):
+            # -> returns the accessed value as JSON text; ->> as bare text
+            # (reference json functions, arroyo-planner json.rs)
+            fn = "json_get" if e.op == "->" else "json_get_str"
+            return Func(fn, (compile_expr(e.left, scope), compile_expr(e.right, scope)))
         return BinOp(e.op, compile_expr(e.left, scope), compile_expr(e.right, scope))
     if isinstance(e, UnaryOp):
         if e.op == "not":
@@ -370,7 +376,8 @@ def infer_dtype(expr: Expr, field_dtypes: dict[str, str]) -> str:
             return "int64" if name != "hash" else "uint64"
         if name in ("is_null", "is_not_null", "like"):
             return "bool"
-        if name in ("lower", "upper", "substring", "md5", "concat"):
+        if name in ("lower", "upper", "substring", "md5", "concat",
+                    "json_get", "json_get_str"):
             return "string"
         if name in ("floor", "ceil", "round", "sqrt", "power", "ln", "log10", "exp"):
             return "float64"

@@ -24,7 +24,7 @@ class Token:
         return self.value.upper()
 
 
-_MULTI_OPS = ["<>", "!=", ">=", "<=", "||", "::"]
+_MULTI_OPS = ["<>", "!=", ">=", "<=", "||", "::", "->>", "->"]
 _SINGLE_OPS = "+-*/%(),.;=<>[]"
 
 
@@ -103,7 +103,12 @@ def tokenize(sql: str) -> list[Token]:
             toks.append(Token("ident", sql[i:j], i))
             i = j
             continue
-        # operators
+        # operators (longest match first: ->> before ->)
+        three = sql[i : i + 3]
+        if three in _MULTI_OPS:
+            toks.append(Token("op", three, i))
+            i += 3
+            continue
         two = sql[i : i + 2]
         if two in _MULTI_OPS:
             toks.append(Token("op", two, i))

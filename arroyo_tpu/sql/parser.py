@@ -248,13 +248,16 @@ class Parser:
 
     def _parse_column_def(self) -> ColumnDef:
         if self.at_kw("WATERMARK"):
-            # WATERMARK FOR col AS (expr) — flink-style; represented as a
-            # generated column named "_watermark_for_<col>"
+            # WATERMARK FOR col [AS (expr)] — flink-style; represented as a
+            # generated column named "_watermark_for_<col>"; without AS the
+            # column itself is the watermark expression
             self.next()
             self.expect_kw("FOR")
             col = self.ident()
-            self.expect_kw("AS")
-            expr = self.parse_expr()
+            if self.eat_kw("AS"):
+                expr = self.parse_expr()
+            else:
+                expr = Ident(col)
             return ColumnDef(f"__watermark_for_{col}", "WATERMARK", generated=expr)
         name = self.ident()
         type_parts = [self.ident().upper()]
@@ -514,6 +517,11 @@ class Parser:
     def _parse_postfix(self):
         e = self._parse_primary()
         while True:
+            if self.at_op("->") or self.at_op("->>"):
+                # JSON access: -> yields JSON text, ->> unquoted text
+                op = self.next().value
+                e = BinaryOp(op, e, self._parse_primary())
+                continue
             if self.eat_op("::"):
                 tname = self.ident().upper()
                 while self.peek().kind == "ident" and self.peek().upper() in ("PRECISION", "UNSIGNED"):

@@ -196,6 +196,10 @@ class Planner:
         self.parallelism = parallelism
         self.tables: dict[str, TableDecl] = {}
         self.views: dict[str, Select] = {}
+        # connector-less tables: INSERT INTO them plants a named stream in
+        # the dataflow that later SELECTs tap (reference memory tables,
+        # planner tables.rs Table::MemoryTable)
+        self.memory_rels: dict[str, "Rel"] = {}
         self.graph = Graph()
         self.sinks: list[SinkInfo] = []
         self.settings: dict = {}
@@ -255,9 +259,14 @@ class Planner:
         if name in self.views:
             rel = self.plan_select(self.views[name])
             return self._aliased(rel, tr.alias or name)
+        if name in self.memory_rels:
+            return self._aliased(self.memory_rels[name], tr.alias or name)
         if name not in self.tables:
             raise PlanError(f"unknown table {name!r}")
         decl = self.tables[name]
+        if decl.options.get("connector") is None:
+            raise PlanError(
+                f"memory table {name!r} is read before any INSERT INTO writes it")
         if decl.ttype == "sink":
             raise PlanError(f"table {name!r} is a sink; cannot SELECT from it")
         return self._plan_source(decl, tr.alias or name)
@@ -349,7 +358,11 @@ class Planner:
         wid = self._id("watermark", decl.name)
         self._add_node(wid, OpName.WATERMARK, wm_cfg)
         self._edge(rel, wid, EdgeType.FORWARD, rel.schema())
-        return Rel(wid, dtypes, rel.scope)
+        # a debezium source is an UPDATING relation: rows carry _is_retract
+        # and downstream plans must use retract-aware operators (reference
+        # tables.rs is_updating; de.rs debezium handling)
+        updating = str(decl.options.get("format", "")) == "debezium_json"
+        return Rel(wid, dtypes, rel.scope, updating)
 
     # --------------------------------------------------------------- select
 
@@ -409,6 +422,7 @@ class Planner:
 
     def _plan_projection(self, rel: Rel, q: Select) -> Rel:
         rel, q = self._plan_async_udfs(rel, q)
+        rel, q = self._plan_unnest(rel, q)
         pairs = self._expand_items(q.items, rel.scope)
         proj: list[tuple[str, Expr]] = []
         dtypes: dict[str, str] = {}
@@ -444,6 +458,61 @@ class Planner:
         # rel.window (the branch's windowing trait) carries through a
         # projection even when the window struct columns are dropped
         return Rel(vid, dtypes, out_scope, rel.updating, rel.window, rel.keyed)
+
+    def _plan_unnest(self, rel: Rel, q: Select):
+        """unnest(array_col) select items explode through a dedicated
+        UNNEST node (reference UnnestRewriter, rewriters.rs:323); at most
+        one unnest per projection, matching the reference."""
+        unnests = [
+            (i, it) for i, it in enumerate(q.items)
+            if not isinstance(it.expr, Star)
+            and isinstance(it.expr, FuncCall) and it.expr.name == "unnest"
+        ]
+        if not unnests:
+            return rel, q
+        if len(unnests) > 1:
+            raise PlanError("only one unnest() per SELECT is supported")
+        i, it = unnests[0]
+        call = it.expr
+        if call.star or len(call.args) != 1:
+            raise PlanError("unnest() takes exactly one argument")
+        out_name = self._item_name(it, i)
+        arr = compile_expr(call.args[0], rel.scope)
+        arr_dt = infer_dtype(arr, rel.dtypes)
+        elem_dt = arr_dt.split(":", 1)[1] if arr_dt.startswith("array:") else "int64"
+        # stage the array column, then explode it
+        vid = self._id("value", "pre_unnest")
+        self._add_node(vid, OpName.VALUE, {
+            "projections": [("__unnest_in", arr)]
+            + [(n, Col(p)) for _q2, n, k, p in rel.scope._order if k == "col"],
+        })
+        self._edge(rel, vid, EdgeType.FORWARD, rel.schema())
+        uid = self._id("unnest")
+        self._add_node(uid, OpName.UNNEST, {
+            "column": "__unnest_in", "out_name": out_name, "out_dtype": elem_dt})
+        dt2 = dict(rel.dtypes)
+        dt2["__unnest_in"] = arr_dt
+        self._edge(vid, uid, EdgeType.FORWARD, Schema.of(
+            [(n, "string" if d.startswith("array:") else d) for n, d in dt2.items()]
+            + [(TIMESTAMP_FIELD, "int64")]))
+        scope = Scope()
+        dtypes: dict[str, str] = {}
+        for q2, n, k, p in rel.scope._order:
+            # preserve qualifiers and window structs: other select items /
+            # WHERE may reference t.col or the window after the rewrite
+            if k == "col":
+                scope.add_col(q2, n, p)
+                dtypes[p] = rel.dtypes[p]
+            else:
+                scope.add_window(q2, n, p)
+        scope.add_col(None, out_name, out_name)
+        dtypes[out_name] = elem_dt
+        new_rel = Rel(uid, dtypes, scope, rel.updating, rel.window, rel.keyed)
+        items = list(q.items)
+        items[i] = SelectItem(Ident(out_name), it.alias)
+        q2 = Select(items, q.from_table, q.joins, q.where, q.group_by,
+                    q.having, q.order_by, q.limit, q.distinct)
+        return new_rel, q2
 
     def _plan_async_udfs(self, rel: Rel, q: Select):
         """Select items calling async Python UDFs get their own dataflow
@@ -622,6 +691,14 @@ class Planner:
             if a.name == "count":
                 aggregates.append((out, "count", None))
                 agg_out_dtypes[out] = "int64"
+            elif a.name == "array_agg":
+                # collect-kind accumulator (reference datafusion array_agg +
+                # UnnestRewriter pairing, rewriters.rs:323)
+                if a.star or len(a.args) != 1:
+                    raise PlanError("array_agg() takes exactly one argument")
+                e = compile_expr(a.args[0], rel.scope)
+                aggregates.append((out, "collect", e))
+                agg_out_dtypes[out] = f"array:{infer_dtype(e, rel.dtypes)}"
             elif a.name not in ("sum", "min", "max", "avg"):
                 from ..udf import lookup_udaf
 
@@ -698,10 +775,19 @@ class Planner:
             agg_cfg["gap_micros"] = window.gap
         if rel.updating and window is not None:
             raise PlanError("windowed aggregates over updating inputs are unsupported")
-        if any(k.startswith("udaf:") for _n, k, _e in aggregates) and op != OpName.SESSION_AGGREGATE:
-            # UDAF state is host-resident collected values; the HBM window
-            # stores hold fixed-dtype accumulator lanes only
-            raise PlanError("UDAFs are currently supported in session windows only")
+        has_collect = any(k.startswith("udaf:") or k == "collect"
+                          for _n, k, _e in aggregates)
+        if has_collect and op not in (OpName.SESSION_AGGREGATE,
+                                      OpName.TUMBLING_AGGREGATE):
+            # collected values are host-resident python lists; the sliding
+            # path's partial-combine arithmetic and the updating path's
+            # retractions have no list analog
+            raise PlanError(
+                "array_agg/UDAFs are supported in session and tumbling "
+                "windows only")
+        if has_collect and op == OpName.TUMBLING_AGGREGATE:
+            # object lanes cannot ride HBM; force the host aggregator
+            agg_cfg["backend"] = "numpy"
         aid = self._id("agg", op.value)
         self._add_node(aid, op, agg_cfg, parallelism=None if keyed else 1)
         self._edge(cur, aid, EdgeType.SHUFFLE if keyed else EdgeType.FORWARD, cur.schema())
@@ -1124,6 +1210,15 @@ class Planner:
         decl = self.tables[stmt.table]
         if decl.ttype == "source":
             raise PlanError(f"table {stmt.table!r} is a source; cannot INSERT into it")
+        if decl.options.get("connector") is None:
+            # memory table: no sink node — the coerced stream itself becomes
+            # the named relation later FROM clauses read
+            if stmt.table in self.memory_rels:
+                raise PlanError(
+                    f"memory table {stmt.table!r} already written; multiple "
+                    "INSERTs into one memory table are unsupported")
+            self.memory_rels[stmt.table] = self._coerce_to_decl(rel, decl)
+            return
         out_names = list(rel.dtypes)
         sink_cols = decl.physical_columns()
         if sink_cols:
@@ -1162,6 +1257,36 @@ class Planner:
                        description=f"{decl.connector}:{decl.name}")
         self._edge(src_id, sid, EdgeType.FORWARD, sink_schema)
         self.sinks.append(SinkInfo(sid, stmt.table, decl.connector))
+
+    def _coerce_to_decl(self, rel: Rel, decl: TableDecl) -> Rel:
+        """Project a query's output positionally onto a declared column list
+        (names + dtypes), as the sink path does, yielding a Rel scoped under
+        the declared names — the body of a memory table."""
+        cols = decl.physical_columns()
+        out_names = list(rel.dtypes)
+        if not cols:
+            return rel
+        if len(cols) != len(out_names):
+            raise PlanError(
+                f"INSERT INTO {decl.name}: query produces {len(out_names)} "
+                f"columns but table declares {len(cols)}")
+        proj = []
+        dtypes: dict[str, str] = {}
+        for c, src in zip(cols, out_names):
+            dt = sql_type_to_dtype(c.type_name)
+            src_dt = rel.dtypes[src]
+            e: Expr = Col(src)
+            if dt != src_dt and not ({dt, src_dt} <= {"timestamp", "int64"}):
+                e = Cast(e, "int64" if dt == "timestamp" else dt)
+            proj.append((c.name, e))
+            dtypes[c.name] = dt
+        vid = self._id("value", f"{decl.name}_memory")
+        self._add_node(vid, OpName.VALUE, {"projections": proj})
+        self._edge(rel, vid, EdgeType.FORWARD, rel.schema())
+        scope = Scope()
+        for c in cols:
+            scope.add_col(None, c.name, c.name)
+        return Rel(vid, dtypes, scope, rel.updating, rel.window, rel.keyed)
 
     def _plan_preview(self, q: Select) -> None:
         rel = self.plan_select(q)

@@ -59,10 +59,109 @@ def dtype_of_from_config(cfg: dict):
     return lambda e: np.dtype(np.float64)
 
 
+class CollectingAggregator:
+    """Wraps the numeric aggregator with host-side object lanes for
+    "collect"-kind accumulators (array_agg / UDAF state). Numeric lanes ride
+    the wrapped slot tables untouched; list state lives in a host dict keyed
+    (rel_bin, key_hash). Positional acc layout is preserved end-to-end so
+    the window operators need no index remapping. Synchronous only — the
+    planner forces backend="numpy" when a collect accumulator is present."""
+
+    def __init__(self, acc_kinds, acc_dtypes, inner_factory):
+        self.kinds = tuple(acc_kinds)
+        self.col_idx = [i for i, k in enumerate(acc_kinds) if k == "collect"]
+        self.num_idx = [i for i, k in enumerate(acc_kinds) if k != "collect"]
+        # the inner aggregator tracks (key, bin) membership; with no numeric
+        # user lane a hidden count keeps every group represented
+        self._hidden = not self.num_idx
+        inner_kinds = tuple(acc_kinds[i] for i in self.num_idx) or ("count",)
+        inner_dtypes = (tuple(acc_dtypes[i] for i in self.num_idx)
+                        or (np.dtype(np.int64),))
+        self.inner = inner_factory(inner_kinds, inner_dtypes)
+        # (rel_bin, key_hash) -> [list per collect acc]
+        self.store: dict[tuple[int, int], list[list]] = {}
+
+    def update(self, hashes, rel, vals) -> None:
+        nvals = [vals[i] for i in self.num_idx]
+        if self._hidden:
+            nvals = [np.ones(len(hashes), dtype=np.int64)]
+        self.inner.update(hashes, rel, nvals)
+        # store keys use the SIGNED view of the hash, matching _assemble/
+        # restore and the inner aggregator's convention (ops/aggregate.py)
+        signed = hashes.astype(np.uint64).view(np.int64)
+        order = np.lexsort((signed, rel))
+        h_s = signed[order]
+        r_s = rel[order]
+        brk = np.ones(len(h_s), dtype=bool)
+        if len(h_s) > 1:
+            brk[1:] = (h_s[1:] != h_s[:-1]) | (r_s[1:] != r_s[:-1])
+        starts = np.flatnonzero(brk)
+        ends = np.append(starts[1:], len(h_s))
+        cvals = [np.asarray(vals[i], dtype=object)[order] for i in self.col_idx]
+        for s, e in zip(starts, ends):
+            ent = self.store.setdefault(
+                (int(r_s[s]), int(h_s[s])), [[] for _ in self.col_idx])
+            for j, cv in enumerate(cvals):
+                ent[j].extend(cv[s:e].tolist())
+
+    def _assemble(self, keys, bins, naccs, pop: bool):
+        """Positionally recombine numeric lanes with collect lists for the
+        given (key, bin) rows; pop=True consumes store entries (extract)."""
+        from ..batch import object_column
+
+        out: list = [None] * len(self.kinds)
+        ni = 0
+        for i in self.num_idx:
+            out[i] = naccs[ni]
+            ni += 1
+        if len(keys):
+            signed = keys.astype(np.uint64).view(np.int64)
+            for j, i in enumerate(self.col_idx):
+                if pop and j == len(self.col_idx) - 1:
+                    ents = [self.store.pop((int(b), int(k)), None)
+                            for k, b in zip(signed, bins)]
+                else:
+                    ents = [self.store.get((int(b), int(k)))
+                            for k, b in zip(signed, bins)]
+                out[i] = object_column(
+                    (list(e[j]) if e is not None else []) for e in ents)
+        else:
+            for i in self.col_idx:
+                out[i] = np.empty(0, dtype=object)
+        return out
+
+    def extract(self, lo, hi, before):
+        keys, bins, naccs = self.inner.extract(lo, hi, before)
+        return keys, bins, self._assemble(keys, bins, naccs, pop=True)
+
+    def snapshot(self):
+        keys, bins, naccs = self.inner.snapshot()
+        return keys, bins, self._assemble(keys, bins, naccs, pop=False)
+
+    def restore(self, hashes, rel, accs) -> None:
+        naccs = [accs[i] for i in self.num_idx]
+        if self._hidden:
+            # rebuild the hidden count lane from the collect list lengths
+            naccs = [np.array([len(l) for l in accs[self.col_idx[0]]],
+                              dtype=np.int64)]
+        self.inner.restore(hashes, rel, naccs)
+        signed = hashes.astype(np.uint64).view(np.int64)
+        for row, (k, b) in enumerate(zip(signed, rel)):
+            ent = self.store.setdefault((int(b), int(k)), [[] for _ in self.col_idx])
+            for j, i in enumerate(self.col_idx):
+                ent[j] = list(accs[i][row])
+
+
 def make_window_aggregator(acc_kinds, acc_dtypes, backend: str):
     """Single-chip SlotAggregator or (device.mesh-devices > 1) the
     key-space-sharded ShardedAggregator — one construction path shared by
-    every window operator so capacity knobs cannot drift between them."""
+    every window operator so capacity knobs cannot drift between them.
+    collect-kind accumulators (array_agg / UDAF state) wrap the numeric
+    aggregator with host-side object lanes."""
+    if "collect" in acc_kinds:
+        return CollectingAggregator(
+            acc_kinds, acc_dtypes,
+            lambda ks, ds: make_window_aggregator(ks, ds, "numpy"))
     dev = config().section("device")
     mesh_n = int(dev.get("mesh-devices", 0) or 0)
     if backend == "jax" and mesh_n > 1:
@@ -108,9 +207,9 @@ def acc_plan(aggregates: list[tuple[str, str, Optional[Expr]]], schema_dtype_of)
             kinds.extend(["sum", "count"])
             dtypes.extend([np.dtype(np.float64), np.dtype(np.int64)])
             inputs.extend([expr, None])
-        elif kind.startswith("udaf:"):
-            # UDAF state = collected input values (host-resident python
-            # lists; the planner restricts these to session windows)
+        elif kind.startswith("udaf:") or kind == "collect":
+            # UDAF state / array_agg = collected input values (host-resident
+            # python lists; planner allows session + tumbling windows)
             kinds.append("collect")
             dtypes.append(np.dtype(object))
             inputs.append(expr)

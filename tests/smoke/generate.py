@@ -97,6 +97,31 @@ def gen_customers():
     return rows
 
 
+def gen_aggregate_updates():
+    """Debezium envelope stream over an orders table (id pk): creates,
+    updates (quantity/status churn), deletes — deterministic."""
+    envs = []
+    state = {}
+    products = ["widget", "gadget", "sprocket"]
+    for i in range(60):
+        row = {
+            "id": i, "customer_name": f"cust_{i % 8}",
+            "product_name": products[i % 3], "quantity": (i * 7) % 20 + 1,
+            "price": round(9.99 + (i % 5) * 2.5, 2), "status": "new",
+        }
+        envs.append({"before": None, "after": row, "op": "c"})
+        state[i] = row
+    for i in range(0, 60, 4):  # update every 4th order
+        before = dict(state[i])
+        after = dict(before, quantity=before["quantity"] + 3, status="shipped")
+        envs.append({"before": before, "after": after, "op": "u"})
+        state[i] = after
+    for i in range(0, 60, 10):  # delete every 10th
+        envs.append({"before": dict(state[i]), "after": None, "op": "d"})
+        del state[i]
+    return envs, state
+
+
 def input_ts(row, field):
     s = row[field].replace("+00:00", "")
     dt = datetime.fromisoformat(s).replace(tzinfo=timezone.utc)
@@ -333,6 +358,111 @@ def o_updating_left_join(ins):
     return out
 
 
+def _final_right_sub(ins):
+    """Final state of the updating subquery: count(*) per counter%2 over
+    impulse counters < 3 -> [(counter_mod_2, right_count)]."""
+    byg = defaultdict(int)
+    for r in ins["impulse"]:
+        if r["counter"] < 3:
+            byg[r["counter"] % 2] += 1
+    return sorted(byg.items())
+
+
+def o_updating_right_join(ins):
+    """impulse RIGHT JOIN updating-agg subquery ON counter = right_count
+    WHERE counter < 3 (reference rejects updating right sides; we run it).
+    The WHERE on the nullable left column drops null-padded rows."""
+    counters = {r["counter"] for r in ins["impulse"]}
+    out = []
+    for cm2, rc in _final_right_sub(ins):
+        if rc in counters and rc < 3:
+            out.append({"left_counter": rc, "counter_mod_2": cm2, "right_count": rc})
+    return out
+
+
+def o_updating_full_join(ins):
+    """(impulse counters < 5) FULL JOIN updating-agg subquery ON
+    counter = right_count: matches plus null-padded rows from BOTH sides."""
+    left = sorted({r["counter"] for r in ins["impulse"] if r["counter"] < 5})
+    sub = _final_right_sub(ins)
+    matched_rc = set()
+    out = []
+    for cm2, rc in sub:
+        if rc in left:
+            out.append({"left_counter": rc, "counter_mod_2": cm2, "right_count": rc})
+            matched_rc.add(rc)
+    for c in left:
+        if c not in matched_rc:
+            out.append({"left_counter": c, "counter_mod_2": None, "right_count": None})
+    for cm2, rc in sub:
+        if rc not in left:
+            out.append({"left_counter": None, "counter_mod_2": cm2, "right_count": rc})
+    return out
+
+
+def o_updating_inner_join_with_updating(ins):
+    counters = {r["counter"] for r in ins["impulse"]}
+    return [
+        {"left_counter": rc, "counter_mod_2": cm2, "right_count": rc}
+        for cm2, rc in _final_right_sub(ins)
+        if rc in counters and rc < 3
+    ]
+
+
+def o_debezium_pass_through(ins):
+    _envs, final = gen_aggregate_updates()
+    return [
+        {"id": r["id"], "customer_name": r["customer_name"],
+         "product_name": r["product_name"], "quantity": r["quantity"],
+         "price": r["price"], "status": r["status"]}
+        for r in final.values()
+    ]
+
+
+def o_debezium_coercion(ins):
+    return [{"counter": r["counter"]} for r in ins["impulse"]]
+
+
+def o_debezium_agg(ins):
+    _envs, final = gen_aggregate_updates()
+    byp = defaultdict(lambda: [0, 0])
+    for r in final.values():
+        acc = byp[f"p_{r['product_name']}"]
+        acc[0] += 1
+        acc[1] += r["quantity"] + 5
+    return [{"p": p, "c": c, "q": q + 10} for p, (c, q) in sorted(byp.items())]
+
+
+def o_json_operators(ins):
+    return [
+        {"a": "test", "b": json.dumps(r["driver_id"]),
+         "c": json.dumps(r["event_type"]), "d": "null"}
+        for r in ins["cars"]
+    ]
+
+
+def o_unnest_in_view(ins):
+    return [{"counter": r["counter"]} for r in ins["impulse"]]
+
+
+def o_offset_impulse_join(ins):
+    W = 1 * S
+    out = []
+    for r in ins["impulse"]:
+        ts = input_ts(r, "timestamp")
+        out.append({"start": iso(tumble_start(ts, W)), "counter": r["counter"]})
+    return out
+
+
+def o_async_udf(ins):
+    return [{"counter": -2 * r["counter"]} for r in ins["impulse"]]
+
+
+def o_memory_table(ins):
+    return [{"driver_id": r["driver_id"], "event_type": r["event_type"]}
+            for r in ins["cars"]]
+
+
 def o_window_function(ins):
     W = 10 * S
     byk = defaultdict(int)
@@ -514,6 +644,17 @@ ORACLES = {
     "filter_updating_aggregates": o_filter_updating_aggregates,
     "updating_inner_join": o_updating_inner_join,
     "updating_left_join": o_updating_left_join,
+    "updating_right_join": o_updating_right_join,
+    "updating_full_join": o_updating_full_join,
+    "updating_inner_join_with_updating": o_updating_inner_join_with_updating,
+    "async_udf": o_async_udf,
+    "memory_table": o_memory_table,
+    "offset_impulse_join": o_offset_impulse_join,
+    "unnest_in_view": o_unnest_in_view,
+    "json_operators": o_json_operators,
+    "debezium_pass_through": o_debezium_pass_through,
+    "debezium_coercion": o_debezium_coercion,
+    "debezium_agg": o_debezium_agg,
     "window_function": o_window_function,
     "union_all": o_union_all,
     "having_filter": o_having_filter,
@@ -526,6 +667,11 @@ UPDATING = {
     "filter_updating_aggregates",
     "updating_inner_join",
     "updating_left_join",
+    "updating_right_join",
+    "updating_full_join",
+    "updating_inner_join_with_updating",
+    "debezium_pass_through",
+    "debezium_agg",
 }
 
 
@@ -544,6 +690,11 @@ def main():
             for r in rows:
                 f.write(json.dumps(r, separators=(",", ":")) + "\n")
         print(f"inputs/{name}.json: {len(rows)} rows")
+    envs, _final = gen_aggregate_updates()
+    with open(os.path.join(INPUTS, "aggregate_updates.json"), "w") as f:
+        for e in envs:
+            f.write(json.dumps(e, separators=(",", ":")) + "\n")
+    print(f"inputs/aggregate_updates.json: {len(envs)} envelopes")
     for qname, oracle in ORACLES.items():
         rows = oracle(ins)
         with open(os.path.join(GOLDEN, f"{qname}.json"), "w") as f:

@@ -1,0 +1,33 @@
+-- Updating aggregate over a DEBEZIUM source: upstream u/d envelopes
+-- retract into the group accumulators (reference debezium_agg.sql;
+-- count(distinct) is narrowed to count(*)+sum, see planner DISTINCT gap).
+CREATE TABLE debezium_source (
+  id INT PRIMARY KEY,
+  customer_name TEXT,
+  product_name TEXT,
+  quantity INTEGER,
+  price FLOAT,
+  status TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/aggregate_updates.json',
+  format = 'debezium_json',
+  type = 'source'
+);
+
+CREATE TABLE output (
+  p TEXT,
+  c BIGINT,
+  q BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+
+INSERT INTO output
+SELECT concat('p_', product_name) AS p, count(*) AS c,
+       CAST(sum(quantity + 5) + 10 AS BIGINT) AS q
+FROM debezium_source
+GROUP BY concat('p_', product_name);

@@ -1,0 +1,34 @@
+-- Debezium SOURCE -> debezium sink pass-through: c/u/d envelopes flow in
+-- as retract-tagged rows and out as envelopes again (reference
+-- debezium_pass_through.sql; de.rs debezium handling).
+CREATE TABLE debezium_source (
+  id INT PRIMARY KEY,
+  customer_name TEXT,
+  product_name TEXT,
+  quantity INTEGER,
+  price FLOAT,
+  status TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/aggregate_updates.json',
+  format = 'debezium_json',
+  type = 'source'
+);
+
+CREATE TABLE output (
+  id INT PRIMARY KEY,
+  customer_name TEXT,
+  product_name TEXT,
+  quantity INTEGER,
+  price FLOAT,
+  status TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+
+INSERT INTO output
+SELECT *
+FROM debezium_source;

@@ -1,0 +1,29 @@
+-- JSON access operators over an unstructured source: -> yields the value
+-- re-serialized as JSON text; missing fields yield "null" (reference
+-- json_operators.sql + golden_outputs/json_operators.json).
+CREATE TABLE cars (
+  value JSON
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  'json.unstructured' = 'true'
+);
+
+CREATE TABLE sink (
+  a TEXT,
+  b TEXT,
+  c TEXT,
+  d TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+
+INSERT INTO sink
+SELECT 'test' AS a, value->'driver_id' AS b, value->'event_type' AS c,
+       value->'not_a_field' AS d
+FROM cars;

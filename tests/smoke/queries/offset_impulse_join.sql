@@ -1,0 +1,50 @@
+-- Windowed join of two readings of the same source where one side's
+-- watermark lags by 10 minutes (WATERMARK FOR ... AS expr DDL); the join
+-- must still line windows up (reference offset_impulse_join.sql).
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP NOT NULL,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL,
+  WATERMARK FOR timestamp
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+
+CREATE TABLE delayed_impulse_source (
+  timestamp TIMESTAMP NOT NULL,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL,
+  WATERMARK FOR timestamp AS (timestamp - INTERVAL '10 minute')
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+
+CREATE TABLE offset_output (
+  start TIMESTAMP,
+  counter BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+
+INSERT INTO offset_output
+SELECT a.window.start, a.counter AS counter
+FROM (
+  SELECT tumble(interval '1 second') AS window, counter, count(*) AS c
+  FROM impulse_source GROUP BY window, counter
+) a
+JOIN (
+  SELECT tumble(interval '1 second') AS window, counter, count(*) AS c
+  FROM delayed_impulse_source GROUP BY window, counter
+) b
+ON a.counter = b.counter AND a.window = b.window;

@@ -1,0 +1,34 @@
+-- FULL OUTER JOIN with an updating right side. The reference rejects this
+-- ("can't handle non-inner joins without windows", updating_full_join.sql
+-- --fail marker); symmetric retractions make it work here. No WHERE on the
+-- left column: null-padded rows from both sides must survive to the sink.
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE output (
+  left_counter BIGINT,
+  counter_mod_2 BIGINT,
+  right_count BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT i.counter AS left_counter, sub.counter_mod_2, sub.right_count
+FROM (SELECT counter, timestamp FROM impulse WHERE counter < 5) i
+FULL JOIN (
+  SELECT CAST(counter % 2 AS BIGINT) AS counter_mod_2,
+         count(*) AS right_count
+  FROM impulse WHERE counter < 3 GROUP BY counter % 2
+) sub
+ON i.counter = sub.right_count;

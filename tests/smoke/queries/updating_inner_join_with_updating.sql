@@ -1,0 +1,35 @@
+-- INNER JOIN where the right side is an updating aggregate. The reference
+-- rejects this ("can't handle updating right side of join",
+-- updating_inner_join_with_updating.sql --fail marker); retract-aware
+-- symmetric join state supports it here.
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE output (
+  left_counter BIGINT,
+  counter_mod_2 BIGINT,
+  right_count BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT i.counter AS left_counter, sub.counter_mod_2, sub.right_count
+FROM impulse i
+INNER JOIN (
+  SELECT CAST(counter % 2 AS BIGINT) AS counter_mod_2,
+         count(*) AS right_count
+  FROM impulse WHERE counter < 3 GROUP BY counter % 2
+) sub
+ON i.counter = sub.right_count
+WHERE i.counter < 3;

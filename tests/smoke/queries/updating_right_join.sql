@@ -1,0 +1,35 @@
+-- RIGHT JOIN of an append stream against an UPDATING aggregate subquery.
+-- The reference REJECTS this ("can't handle updating right side of join",
+-- updating_right_join.sql --fail marker); JoinWithExpiration's symmetric
+-- retract handling supports it, so here it is a positive test.
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE output (
+  left_counter BIGINT,
+  counter_mod_2 BIGINT,
+  right_count BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT i.counter AS left_counter, sub.counter_mod_2, sub.right_count
+FROM impulse i
+RIGHT JOIN (
+  SELECT CAST(counter % 2 AS BIGINT) AS counter_mod_2,
+         count(*) AS right_count
+  FROM impulse WHERE counter < 3 GROUP BY counter % 2
+) sub
+ON i.counter = sub.right_count
+WHERE i.counter < 3;

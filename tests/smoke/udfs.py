@@ -3,9 +3,11 @@ test UDFs from fixture sources the same way, arroyo-planner test/udfs/).
 Importing this module registers them; generate.py mirrors the math in its
 oracles."""
 
+import time
+
 import numpy as np
 
-from arroyo_tpu.udf import register_udaf
+from arroyo_tpu.udf import register_udaf, register_udf
 
 
 def p90(values: np.ndarray) -> float:
@@ -17,5 +19,15 @@ def val_range(values: np.ndarray) -> int:
     return int(v.max() - v.min())
 
 
+def double_negative(counter) -> int:
+    """Async scalar UDF (reference double_negative_udf.sql: an async Rust
+    udf over impulse.counter); the sleep forces real overlap through the
+    bounded-concurrency pool."""
+    time.sleep(0.0002)
+    return -2 * int(counter)
+
+
 register_udaf("p90", p90, return_dtype="float64")
 register_udaf("val_range", val_range, return_dtype="int64")
+register_udf("double_negative", double_negative, return_dtype="int64",
+             is_async=True, max_concurrency=16, ordered=True)

@@ -51,6 +51,41 @@ def expected_counts(count=1000, width_micros=1_000_000, interval=1000):
     return out
 
 
+def test_tumbling_array_agg_keyed():
+    """Keyed array_agg: collect lists must survive the hash round-trip for
+    keys whose 64-bit hash has the top bit set (signed-view store keys —
+    r5 code-review regression) and match per-(window, key) membership."""
+    rows: list = []
+    g = Graph()
+    g.add_node(Node("src", OpName.SOURCE, {
+        "connector": "impulse", "message_count": 500,
+        "interval_micros": 1000, "start_time_micros": 0}, 1))
+    g.add_node(Node("wm", OpName.WATERMARK, {"expr": Col(TIMESTAMP_FIELD)}, 1))
+    g.add_node(Node("key", OpName.KEY,
+                    {"keys": [("k", BinOp("%", Col("counter"), Lit(13)))]}, 1))
+    g.add_node(Node("agg", OpName.TUMBLING_AGGREGATE, {
+        "width_micros": 100_000,
+        "key_fields": ["k"],
+        "aggregates": [("vals", "collect", Col("counter")),
+                       ("cnt", "count", None)],
+        "input_dtype_of": lambda e: np.dtype(np.int64),
+        "backend": "numpy",
+    }, 1))
+    g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": rows}, 1))
+    g.add_edge("src", "wm", EdgeType.FORWARD, DUMMY)
+    g.add_edge("wm", "key", EdgeType.FORWARD, DUMMY)
+    g.add_edge("key", "agg", EdgeType.SHUFFLE, DUMMY)
+    g.add_edge("agg", "sink", EdgeType.FORWARD, DUMMY)
+    run_graph(g, job_id="tw-array-agg", timeout=60)
+    want = {}
+    for c in range(500):
+        want.setdefault((c * 1000 // 100_000, c % 13), []).append(c)
+    got = {(r["window_start"] // 100_000, r["k"]): sorted(r["vals"]) for r in rows}
+    assert got == {k: sorted(v) for k, v in want.items()}
+    # collect lists align row-for-row with the numeric count lane
+    assert all(len(r["vals"]) == r["cnt"] for r in rows)
+
+
 @pytest.mark.parametrize("backend", ["numpy", "jax"])
 def test_tumbling_count_sum(backend):
     rows: list = []
