@@ -469,20 +469,41 @@ class LookupJoin(Operator):
     """config: connector (object with lookup(keys)->dict, from the connector
     registry), key_exprs: [Expr] evaluated on the stream, right_names:
     [(out_name, field)] columns pulled from the looked-up row, join_type:
-    inner|left, cache_ttl_micros, cache_max_size.
-    Reference: lookup_join.rs:35 (async lookups + TTL'd cache table)."""
+    inner|left, cache_ttl_micros, cache_max_size, max_concurrency.
+
+    Async pipelined lookups (reference lookup_join.rs:35): cache misses are
+    batched per input batch and dispatched to a bounded thread pool off the
+    task thread; batches emit strictly in input order as their fetches land,
+    and watermarks/barriers drain everything in flight first, so a slow
+    lookup source overlaps N fetches instead of serializing the hot loop."""
 
     def __init__(self, cfg: dict):
+        from collections import deque
+
         self.connector = cfg["connector"]
         self.key_exprs = list(cfg["key_exprs"])
         self.right_names: list[tuple[str, str]] = list(cfg["right_names"])
         self.join_type = cfg.get("join_type", "left")
         self.cache_ttl = int(cfg.get("cache_ttl_micros", 60_000_000))
         self.cache_max = int(cfg.get("cache_max_size", 100_000))
+        self.max_concurrency = int(cfg.get("max_concurrency", 16))
         self.cache: dict = {}  # key -> (row|None, wall_micros)
+        self._pool = None
+        # FIFO of ("batch", batch, keys, resolved, missing, fut, borrowed)
+        # and ("wm", Watermark) markers: strictly ordered emission
+        self._pending = deque()
+        # key -> in-flight Future: concurrent batches borrow a pending
+        # fetch instead of re-asking the source for the same key
+        self._inflight: dict = {}
 
     def tables(self):
         return [TableSpec("c", "global_keyed")]
+
+    def on_start(self, ctx):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.max_concurrency, thread_name_prefix="lookup-join")
 
     def process_batch(self, batch, ctx, collector, input_index=0):
         n = batch.num_rows
@@ -494,22 +515,75 @@ class LookupJoin(Operator):
             for i in range(n)
         ]
         now = int(_time.time() * 1e6)
-        missing = []
+        # resolve hits AT SUBMIT TIME: deferred emission must not depend on
+        # cache entries that a later eviction sweep could remove
+        resolved: dict = {}
+        missing: list = []
+        borrowed: dict = {}
         for k in set(keys):
             ent = self.cache.get(k)
-            if ent is None or now - ent[1] > self.cache_ttl:
+            if ent is not None and now - ent[1] <= self.cache_ttl:
+                resolved[k] = ent[0]
+            elif k in self._inflight:
+                borrowed[k] = self._inflight[k]
+            else:
                 missing.append(k)
+        fut = None
         if missing:
-            fetched = self.connector.lookup(missing)
+            if self._pool is None:
+                self.on_start(ctx)
+            fut = self._pool.submit(self.connector.lookup, missing)
             for k in missing:
+                self._inflight[k] = fut
+        self._pending.append(("batch", batch, keys, resolved, missing, fut, borrowed))
+        self._drain(collector, block=False)
+        # backpressure: bound in-flight batches so a stalled source cannot
+        # queue unbounded memory behind the pool
+        while sum(1 for e in self._pending if e[0] == "batch") > 2 * self.max_concurrency:
+            self._emit_head(collector)
+
+    def _head_ready(self) -> bool:
+        e = self._pending[0]
+        if e[0] == "wm":
+            return True
+        fut, borrowed = e[5], e[6]
+        if fut is not None and not fut.done():
+            return False
+        return all(f.done() for f in borrowed.values())
+
+    def _drain(self, collector, block: bool) -> None:
+        while self._pending:
+            if not block and not self._head_ready():
+                return
+            self._emit_head(collector)
+
+    def _emit_head(self, collector) -> None:
+        entry = self._pending.popleft()
+        if entry[0] == "wm":
+            from ..types import Signal
+
+            collector.broadcast(Signal.watermark_of(entry[1]))
+            return
+        _tag, batch, keys, resolved, missing, fut, borrowed = entry
+        now = int(_time.time() * 1e6)
+        val_of = dict(resolved)
+        if fut is not None:
+            fetched = fut.result()
+            for k in missing:
+                val_of[k] = fetched.get(k)
                 self.cache[k] = (fetched.get(k), now)
-        rows = [self.cache[k][0] for k in keys]
+                if self._inflight.get(k) is fut:
+                    del self._inflight[k]
+        for k, bf in borrowed.items():
+            val_of[k] = bf.result().get(k)
+        rows = [val_of[k] for k in keys]
         if len(self.cache) > self.cache_max:
             # evict oldest entries — after gathering, so this batch's keys
             # cannot be evicted before they are read
             by_age = sorted(self.cache.items(), key=lambda kv: kv[1][1])
             for k, _ in by_age[: len(self.cache) - self.cache_max]:
                 del self.cache[k]
+        n = batch.num_rows
         present = np.array([r is not None for r in rows], dtype=bool)
         if self.join_type == "inner" and not present.all():
             batch = batch.filter(present)
@@ -527,6 +601,26 @@ class LookupJoin(Operator):
             else:
                 cols[out_name] = np.array(vals)
         collector.collect(Batch(cols))
+
+    def handle_watermark(self, watermark, ctx, collector):
+        # watermark-held ordered emission WITHOUT stalling the pipeline:
+        # the watermark queues behind its preceding batches and broadcasts
+        # as the queue drains (same shape as TumblingAggregate's pending
+        # queue) — blocking here would cap lookup overlap at one batch,
+        # since upstream emits a watermark after nearly every batch
+        self._drain(collector, block=False)
+        if not self._pending:
+            return watermark
+        self._pending.append(("wm", watermark))
+        return None
+
+    def handle_checkpoint(self, barrier, ctx, collector):
+        self._drain(collector, block=True)
+
+    def on_close(self, ctx, collector):
+        self._drain(collector, block=True)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
 
 
 @register_operator(OpName.INSTANT_JOIN)

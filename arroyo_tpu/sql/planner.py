@@ -480,11 +480,15 @@ class Planner:
         arr = compile_expr(call.args[0], rel.scope)
         arr_dt = infer_dtype(arr, rel.dtypes)
         elem_dt = arr_dt.split(":", 1)[1] if arr_dt.startswith("array:") else "int64"
-        # stage the array column, then explode it
+        # stage the array column, then explode it; carry columns under their
+        # PHYSICAL names (display names can collide across join sides)
+        carried: list[str] = []
+        for _q2, _n, k, p in rel.scope._order:
+            if k == "col" and p not in carried:
+                carried.append(p)
         vid = self._id("value", "pre_unnest")
         self._add_node(vid, OpName.VALUE, {
-            "projections": [("__unnest_in", arr)]
-            + [(n, Col(p)) for _q2, n, k, p in rel.scope._order if k == "col"],
+            "projections": [("__unnest_in", arr)] + [(p, Col(p)) for p in carried],
         })
         self._edge(rel, vid, EdgeType.FORWARD, rel.schema())
         uid = self._id("unnest")
