@@ -51,7 +51,7 @@ def load_all() -> None:
     from . import blackhole, impulse, single_file, stdout, vec  # noqa: F401
     from . import nexmark  # noqa: F401
     from . import filesystem, http_conn, kafka, preview, redis  # noqa: F401
-    from . import mqtt, nats, stubs, websocket  # noqa: F401
+    from . import kinesis, mqtt, nats, rabbitmq, stubs, websocket  # noqa: F401
 
 
 def connectors() -> dict:

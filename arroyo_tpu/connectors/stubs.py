@@ -1,9 +1,10 @@
 """Gated connectors: broker integrations that need client libraries not in
-the air-gapped image (reference arroyo-connectors §2.9). mqtt and nats have
-from-scratch protocol implementations (mqtt.py / nats.py); the remainder
-register here with their config surface documented, and constructing one
-without its client package raises with install instructions, matching how
-the kafka connector degrades.
+the air-gapped image (reference arroyo-connectors §2.9). mqtt, nats,
+rabbitmq, and kinesis have from-scratch protocol implementations (their own
+modules); fluvio's wire protocol is a moving custom binary format with no
+stable public spec, so it registers here with its config surface documented,
+and constructing one without its client package raises with install
+instructions, matching how the kafka connector degrades.
 """
 
 from __future__ import annotations
@@ -11,19 +12,9 @@ from __future__ import annotations
 from . import register_sink, register_source
 
 _SPECS = {
-    "kinesis": {
-        "package": "boto3",
-        "options": ["stream_name", "aws_region", "source.offset"],
-        "kinds": ("source", "sink"),
-    },
     "fluvio": {
         "package": "fluvio",
         "options": ["endpoint", "topic"],
-        "kinds": ("source", "sink"),
-    },
-    "rabbitmq": {
-        "package": "pika",
-        "options": ["host", "port", "queue", "exchange"],
         "kinds": ("source", "sink"),
     },
 }

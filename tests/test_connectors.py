@@ -445,13 +445,13 @@ def test_preview_rows_via_rest(tmp_path, _storage):
 
 
 def test_gated_connector_raises_helpfully(_storage):
-    # mqtt/nats grew real from-scratch implementations; rabbitmq remains
-    # gated on its client package
+    # mqtt/nats/rabbitmq/kinesis grew real from-scratch implementations;
+    # fluvio remains gated on its client package (no public wire spec)
     arroyo_tpu._load_operators()
     from arroyo_tpu.connectors import _SOURCES
 
-    with pytest.raises(ImportError, match="pika"):
-        _SOURCES["rabbitmq"]({"host": "x"})
+    with pytest.raises(ImportError, match="fluvio"):
+        _SOURCES["fluvio"]({"endpoint": "x"})
 
 
 def test_connector_registry_lists_all(_storage):
