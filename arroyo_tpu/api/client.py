@@ -98,6 +98,41 @@ class ArroyoClient:
     def list_connectors(self) -> dict:
         return self._req("GET", "/api/v1/connectors")
 
+    # ------------------------------------------- connection tables/profiles
+
+    def create_connection_profile(self, name: str, connector: str,
+                                  config: Optional[dict] = None) -> dict:
+        return self._req("POST", "/api/v1/connection_profiles",
+                         {"name": name, "connector": connector,
+                          "config": config or {}})
+
+    def list_connection_profiles(self) -> list[dict]:
+        return self._req("GET", "/api/v1/connection_profiles")["data"]
+
+    def delete_connection_profile(self, profile_id: str) -> dict:
+        return self._req("DELETE", f"/api/v1/connection_profiles/{profile_id}")
+
+    def create_connection_table(self, name: str, connector: str,
+                                table_type: str = "source",
+                                config: Optional[dict] = None,
+                                schema_fields: Optional[list[dict]] = None,
+                                profile_id: Optional[str] = None) -> dict:
+        body: dict = {"name": name, "connector": connector,
+                      "table_type": table_type, "config": config or {},
+                      "schema_fields": schema_fields or []}
+        if profile_id:
+            body["profile_id"] = profile_id
+        return self._req("POST", "/api/v1/connection_tables", body)
+
+    def list_connection_tables(self) -> list[dict]:
+        return self._req("GET", "/api/v1/connection_tables")["data"]
+
+    def delete_connection_table(self, table_id: str) -> dict:
+        return self._req("DELETE", f"/api/v1/connection_tables/{table_id}")
+
+    def test_connection_table(self, **spec) -> dict:
+        return self._req("POST", "/api/v1/connection_tables/test", spec)
+
     def create_udf(self, name: str, source: str, language: str = "cpp",
                    arg_dtypes: Optional[list[str]] = None,
                    return_dtype: str = "float64") -> dict:

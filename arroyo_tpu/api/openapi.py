@@ -64,6 +64,27 @@ NODE = {
     "properties": {"node_id": _STR, "addr": _STR, "slots": _INT},
     "required": ["node_id", "addr"],
 }
+CONNECTION_PROFILE = {
+    "type": "object",
+    "properties": {"name": _STR, "connector": _STR,
+                   "config": {"type": "object"}},
+    "required": ["name", "connector"],
+}
+CONNECTION_TABLE = {
+    "type": "object",
+    "properties": {
+        "name": _STR, "connector": _STR,
+        "table_type": {"type": "string", "enum": ["source", "sink"]},
+        "profile_id": _STR,
+        "config": {"type": "object"},
+        "schema_fields": {"type": "array", "items": {
+            "type": "object",
+            "properties": {"name": _STR, "type": _STR,
+                           "nullable": {"type": "boolean"}},
+            "required": ["name", "type"]}},
+    },
+    "required": ["name", "connector"],
+}
 
 
 def spec() -> dict:
@@ -120,6 +141,27 @@ def spec() -> dict:
                 "get": _op("job_metrics", "operator metric groups", ["job_id"])},
             "/api/v1/connectors": {
                 "get": _op("list_connectors", "available connectors")},
+            "/api/v1/connection_profiles": {
+                "post": _op("create_connection_profile",
+                            "register shared connector options",
+                            body=CONNECTION_PROFILE),
+                "get": _op("list_connection_profiles",
+                           "list connection profiles")},
+            "/api/v1/connection_profiles/{id}": {
+                "delete": _op("delete_connection_profile",
+                              "drop an unreferenced profile", ["id"])},
+            "/api/v1/connection_tables": {
+                "post": _op("create_connection_table",
+                            "register a named source/sink usable in SQL",
+                            body=CONNECTION_TABLE),
+                "get": _op("list_connection_tables", "list connection tables")},
+            "/api/v1/connection_tables/{id}": {
+                "delete": _op("delete_connection_table",
+                              "drop a connection table", ["id"])},
+            "/api/v1/connection_tables/test": {
+                "post": _op("test_connection_table",
+                            "validate a connection-table spec",
+                            body=CONNECTION_TABLE)},
             "/api/v1/udfs": {
                 "post": _op("create_udf", "compile/register a UDF", body=UDF),
                 "get": _op("list_udfs", "list registered UDFs")},

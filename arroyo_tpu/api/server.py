@@ -92,6 +92,13 @@ class ApiServer:
         ("GET", r"^/api/v1/jobs/([^/]+)/output$", "_job_output"),
         ("GET", r"^/api/v1/jobs/([^/]+)/metrics$", "_job_metrics"),
         ("GET", r"^/api/v1/connectors$", "_connectors"),
+        ("POST", r"^/api/v1/connection_profiles$", "_create_profile"),
+        ("GET", r"^/api/v1/connection_profiles$", "_list_profiles"),
+        ("DELETE", r"^/api/v1/connection_profiles/([^/]+)$", "_delete_profile"),
+        ("POST", r"^/api/v1/connection_tables$", "_create_conn_table"),
+        ("GET", r"^/api/v1/connection_tables$", "_list_conn_tables"),
+        ("DELETE", r"^/api/v1/connection_tables/([^/]+)$", "_delete_conn_table"),
+        ("POST", r"^/api/v1/connection_tables/test$", "_test_conn_table"),
         ("POST", r"^/api/v1/nodes/register$", "_register_node"),
         ("POST", r"^/api/v1/nodes/([^/]+)/heartbeat$", "_node_heartbeat"),
         ("GET", r"^/api/v1/nodes$", "_list_nodes"),
@@ -156,7 +163,8 @@ class ApiServer:
         body = h._body()
         try:
             self._activate_udfs()
-            plan_query(body.get("query", ""))
+            plan_query(body.get("query", ""),
+                       connection_tables=self.db.list_connection_tables())
             h._json(200, {"valid": True, "errors": []})
         except SqlError as e:
             h._json(200, {"valid": False, "errors": [str(e)]})
@@ -238,7 +246,7 @@ class ApiServer:
             return
         try:
             self._activate_udfs()
-            plan_query(query)
+            plan_query(query, connection_tables=self.db.list_connection_tables())
         except SqlError as e:
             h._json(400, {"error": f"invalid query: {e}"})
             return
@@ -335,6 +343,100 @@ class ApiServer:
         from ..connectors import connectors
 
         h._json(200, connectors())
+
+    # ------------------------------------------- connection tables/profiles
+    # (reference arroyo-api/src/rest.rs:144-158 connection_profiles +
+    # connection_tables CRUD; registered tables are usable by name in
+    # pipeline SQL with no inline DDL)
+
+    def _create_profile(self, h):
+        body = h._body()
+        for field in ("name", "connector"):
+            if not body.get(field):
+                h._json(400, {"error": f"missing {field!r}"})
+                return
+        if any(p["name"] == body["name"]
+               for p in self.db.list_connection_profiles()):
+            h._json(409, {"error": f"profile {body['name']!r} already exists"})
+            return
+        cid = self.db.create_connection_profile(
+            body["name"], body["connector"], body.get("config") or {})
+        h._json(200, {"id": cid, "name": body["name"]})
+
+    def _list_profiles(self, h):
+        h._json(200, {"data": self.db.list_connection_profiles()})
+
+    def _delete_profile(self, h, cid):
+        if not self.db.delete_connection_profile(cid):
+            h._json(409, {"error": "profile is referenced by connection tables"})
+            return
+        h._json(200, {"deleted": cid})
+
+    def _validate_conn_table(self, body) -> Optional[str]:
+        """Reason the spec is invalid, or None when usable."""
+        from ..connectors import connectors
+
+        for field in ("name", "connector"):
+            if not body.get(field):
+                return f"missing {field!r}"
+        ttype = body.get("table_type", "source")
+        if ttype not in ("source", "sink"):
+            return "table_type must be 'source' or 'sink'"
+        avail = connectors()
+        reg = avail["sources"] if ttype == "source" else avail["sinks"]
+        if body["connector"] not in reg:
+            return (f"unknown {ttype} connector {body['connector']!r} "
+                    f"(have {sorted(reg)})")
+        fields = body.get("schema_fields") or []
+        if ttype == "source" and not fields and body["connector"] not in (
+                "impulse", "nexmark"):
+            return "source connection tables need at least one schema field"
+        from ..sql.compile import sql_type_to_dtype
+        from ..sql.lexer import SqlError
+
+        for f in fields:
+            try:
+                sql_type_to_dtype(str(f.get("type", "")))
+            except SqlError as e:
+                return f"field {f.get('name')!r}: {e}"
+        return None
+
+    def _test_conn_table(self, h):
+        err = self._validate_conn_table(h._body())
+        h._json(200, {"ok": err is None, "error": err})
+
+    def _create_conn_table(self, h):
+        body = h._body()
+        err = self._validate_conn_table(body)
+        if err:
+            h._json(400, {"error": err})
+            return
+        if any(t["name"] == body["name"]
+               for t in self.db.list_connection_tables()):
+            h._json(409, {"error": f"connection table {body['name']!r} "
+                          "already exists"})
+            return
+        profile_id = body.get("profile_id")
+        config = dict(body.get("config") or {})
+        if profile_id:
+            prof = next((p for p in self.db.list_connection_profiles()
+                         if p["id"] == profile_id), None)
+            if prof is None:
+                h._json(404, {"error": "unknown connection profile"})
+                return
+            # table options override the profile's shared options
+            config = {**prof["config"], **config}
+        tid = self.db.create_connection_table(
+            body["name"], body["connector"], body.get("table_type", "source"),
+            config, body.get("schema_fields") or [], profile_id)
+        h._json(200, {"id": tid, "name": body["name"]})
+
+    def _list_conn_tables(self, h):
+        h._json(200, {"data": self.db.list_connection_tables()})
+
+    def _delete_conn_table(self, h, tid):
+        self.db.delete_connection_table(tid)
+        h._json(200, {"deleted": tid})
 
     # ------------------------------------------------------------ lifecycle
 

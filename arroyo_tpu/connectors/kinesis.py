@@ -245,10 +245,20 @@ class KinesisSource(SourceOperator):
                     resp = client.get_records(it)
                     backoff = 0.0
                 except KinesisError:
-                    # throttling / transient failure: back off, keep the
-                    # iterator, never kill the task over a routine 400
+                    # throttling / transient failure: back off and refresh
+                    # the iterator (a >5min outage expires it — retrying the
+                    # stale one would wedge the shard forever); never kill
+                    # the task over a routine 400
                     backoff = min(max(backoff * 2, 0.2), 5.0)
                     time.sleep(backoff)
+                    try:
+                        if s in seqs:
+                            iters[s] = client.shard_iterator(
+                                self.stream, s, "AFTER_SEQUENCE_NUMBER", seqs[s])
+                        else:
+                            iters[s] = client.shard_iterator(self.stream, s, kind)
+                    except KinesisError:
+                        pass  # next sweep retries with the old iterator
                     continue
                 iters[s] = resp.get("NextShardIterator")
                 for rec in resp.get("Records", []):

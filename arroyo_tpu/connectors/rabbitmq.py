@@ -24,7 +24,7 @@ import time
 from typing import Optional
 
 from ..batch import Schema
-from ..operators.base import Operator, SourceOperator, TableSpec
+from ..operators.base import Operator, SourceOperator
 from ..types import SourceFinishType
 from . import register_sink, register_source
 
@@ -247,31 +247,73 @@ class RabbitmqSource(SourceOperator):
         self.schema: Schema = cfg["schema"]
         self.queue = str(cfg["queue"])
 
-    def tables(self):
-        return [TableSpec("s", "global_keyed")]
-
     def run(self, sctx, collector) -> SourceFinishType:
+        """Checkpoint-deferred acks: tags collect as messages reach the
+        deserializer and ack in one batch when the checkpoint barrier takes
+        them — a crash before the barrier leaves them unacked, so the
+        broker redelivers (at-least-once; duplicates possible)."""
+        import socket as _socket
+        import time as _time
+
+        from ..formats.registry import make_deserializer
+
         client = _client_from(self.cfg)
         client.queue_declare(self.queue)
         client.consume(self.queue)
         client.sock.settimeout(0.2)
-        from .broker_base import run_broker_source
+        de = make_deserializer(self.cfg, self.schema)
+        pending_tags: list[int] = []
+        ka_interval = client.heartbeat / 2 if client.heartbeat else 20.0
+        last_sent = _time.monotonic()
 
-        def next_message():
-            got = client.next_delivery()
+        def flush():
+            b = de.flush()
+            if b is not None:
+                collector.collect(b)
+
+        def ack_pending():
+            for tag in pending_tags:
+                client.ack(tag)
+            pending_tags.clear()
+
+        while True:
+            if client.heartbeat and _time.monotonic() - last_sent > ka_interval:
+                try:
+                    client.send_heartbeat()
+                except OSError:
+                    flush()
+                    return SourceFinishType.GRACEFUL
+                last_sent = _time.monotonic()
+            msg = sctx.poll_control()
+            if msg is not None:
+                if msg.kind == "checkpoint":
+                    flush()
+                    # everything the barrier covers is now durable upstream
+                    # of the broker: safe to ack
+                    ack_pending()
+                    sctx.start_checkpoint(msg.barrier)
+                    if msg.barrier.then_stop:
+                        client.close()
+                        return SourceFinishType.FINAL
+                elif msg.kind == "stop":
+                    client.close()
+                    return SourceFinishType.IMMEDIATE
+            try:
+                got = client.next_delivery()
+            except (TimeoutError, _socket.timeout):
+                if de.should_flush():
+                    flush()
+                continue
+            except ConnectionError:
+                flush()
+                return SourceFinishType.GRACEFUL
             if got is None:
-                return None
+                continue
             tag, body = got
-            client.ack(tag)
-            return body
-
-        # heartbeat=0 negotiated: the broker expects no keepalives, and
-        # sending heartbeat frames anyway is a protocol error on strict ones
-        ka = client.send_heartbeat if client.heartbeat else None
-        interval = client.heartbeat / 2 if client.heartbeat else 20.0
-        return run_broker_source(sctx, collector, self.cfg, self.schema,
-                                 next_message, client.close,
-                                 keepalive=ka, keepalive_interval_s=interval)
+            pending_tags.append(tag)
+            de.deserialize(body, timestamp_micros=int(_time.time() * 1e6))
+            if de.should_flush():
+                flush()
 
 
 @register_sink("rabbitmq")

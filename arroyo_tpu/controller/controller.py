@@ -145,7 +145,9 @@ class JobController:
             self.parallelism = int(want)
             self.db.set_pipeline_parallelism(job["pipeline_id"], int(want))
             self.db.clear_desired_parallelism(self.job_id, int(want))
-        plan_query(self.sql)  # validate; workers re-plan themselves
+        # validate with registered connection tables in scope; workers get
+        # the planned IR (graph_json) so they need no DB access
+        plan_query(self.sql, connection_tables=self.db.list_connection_tables())
         self._set_state(JobState.SCHEDULING)
 
     def _compile_graph(self):
@@ -158,7 +160,8 @@ class JobController:
             from ..sql import plan_query
             from ..sql.planner import set_parallelism
 
-            pp = plan_query(self.sql)
+            pp = plan_query(self.sql,
+                            connection_tables=self.db.list_connection_tables())
             if self.parallelism > 1:
                 set_parallelism(pp.graph, self.parallelism)
             dumped = pp.graph.dumps()

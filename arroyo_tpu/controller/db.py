@@ -52,6 +52,23 @@ CREATE TABLE IF NOT EXISTS udfs (
     artifact_url TEXT,            -- built dylib (cpp only)
     created_at REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS connection_profiles (
+    id TEXT PRIMARY KEY,
+    name TEXT NOT NULL UNIQUE,
+    connector TEXT NOT NULL,
+    config TEXT NOT NULL,         -- JSON options shared by tables
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS connection_tables (
+    id TEXT PRIMARY KEY,
+    name TEXT NOT NULL UNIQUE,
+    connector TEXT NOT NULL,
+    profile_id TEXT REFERENCES connection_profiles(id),
+    table_type TEXT NOT NULL,     -- 'source' | 'sink'
+    config TEXT NOT NULL,         -- JSON connector options
+    schema_fields TEXT NOT NULL,  -- JSON [{name, type, nullable}]
+    created_at REAL NOT NULL
+);
 CREATE TABLE IF NOT EXISTS checkpoints (
     job_id TEXT NOT NULL,
     epoch INTEGER NOT NULL,
@@ -242,6 +259,75 @@ class Database:
     def delete_udf(self, name: str) -> None:
         with self._lock:
             self._conn.execute("DELETE FROM udfs WHERE name=?", (name,))
+            self._conn.commit()
+
+    # ------------------------------------------------- connection tables
+
+    def create_connection_profile(self, name: str, connector: str,
+                                  config: dict) -> str:
+        cid = f"cp_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO connection_profiles (id, name, connector, config, "
+                "created_at) VALUES (?,?,?,?,?)",
+                (cid, name, connector, json.dumps(config), time.time()))
+            self._conn.commit()
+        return cid
+
+    def list_connection_profiles(self) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM connection_profiles ORDER BY name").fetchall()
+        out = []
+        for r in rows:
+            d = dict(r)
+            d["config"] = json.loads(d["config"])
+            out.append(d)
+        return out
+
+    def delete_connection_profile(self, cid: str) -> bool:
+        with self._lock:
+            used = self._conn.execute(
+                "SELECT COUNT(*) FROM connection_tables WHERE profile_id=?",
+                (cid,)).fetchone()[0]
+            if used:
+                return False
+            self._conn.execute(
+                "DELETE FROM connection_profiles WHERE id=?", (cid,))
+            self._conn.commit()
+        return True
+
+    def create_connection_table(self, name: str, connector: str,
+                                table_type: str, config: dict,
+                                schema_fields: list[dict],
+                                profile_id: Optional[str] = None) -> str:
+        tid = f"ct_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO connection_tables (id, name, connector, profile_id, "
+                "table_type, config, schema_fields, created_at) "
+                "VALUES (?,?,?,?,?,?,?,?)",
+                (tid, name, connector, profile_id, table_type,
+                 json.dumps(config), json.dumps(schema_fields), time.time()))
+            self._conn.commit()
+        return tid
+
+    def list_connection_tables(self) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM connection_tables ORDER BY name").fetchall()
+        out = []
+        for r in rows:
+            d = dict(r)
+            d["config"] = json.loads(d["config"])
+            d["schema_fields"] = json.loads(d["schema_fields"])
+            out.append(d)
+        return out
+
+    def delete_connection_table(self, tid: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM connection_tables WHERE id=?", (tid,))
             self._conn.commit()
 
     # ---------------------------------------------------------- checkpoints

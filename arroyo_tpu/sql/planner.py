@@ -1305,8 +1305,30 @@ class Planner:
         self.sinks.append(SinkInfo(sid, "<preview>", "preview", rows))
 
 
-def plan_query(sql: str, parallelism: int = 1) -> PlannedPipeline:
-    return Planner(parallelism).plan(sql)
+def connection_table_decl(ct: dict) -> TableDecl:
+    """A registered connection table (API CRUD rows: name, connector,
+    table_type, config, schema_fields) as a planner TableDecl — pipelines
+    reference it by name with no inline DDL (reference connection_tables
+    registered into the ArroyoSchemaProvider, tables.rs)."""
+    from .ast import ColumnDef
+
+    cols = tuple(
+        ColumnDef(f["name"], str(f.get("type", "TEXT")).upper(),
+                  bool(f.get("nullable", True)))
+        for f in ct.get("schema_fields", [])
+    )
+    options = dict(ct.get("config") or {})
+    options["connector"] = ct["connector"]
+    options["type"] = ct.get("table_type", "source")
+    return TableDecl(ct["name"], cols, options)
+
+
+def plan_query(sql: str, parallelism: int = 1,
+               connection_tables: Optional[list[dict]] = None) -> PlannedPipeline:
+    p = Planner(parallelism)
+    for ct in connection_tables or []:
+        p.tables[ct["name"]] = connection_table_decl(ct)
+    return p.plan(sql)
 
 
 def set_parallelism(graph: Graph, n: int) -> None:

@@ -1,16 +1,20 @@
 """URL-dispatched object storage behind checkpoints and file connectors.
 
 Equivalent of crates/arroyo-storage (StorageProvider, lib.rs:33 /
-BackendConfig, lib.rs:180): one path-string API that reads/writes local
-filesystems or S3-compatible object stores depending on the URL scheme —
-``/abs/path`` or ``file://`` for local, ``s3://bucket/prefix`` for object
-storage (boto3 when available; tests inject a fake client via
-``set_s3_client``). Directory-shaped operations (listdir/isdir/rmtree) are
-emulated on S3 with delimiter listings, mirroring how the reference treats
+BackendConfig, lib.rs:180-340): one path-string API that reads/writes
+local filesystems or object stores depending on the URL scheme —
+``/abs/path`` or ``file://`` for local, ``s3://bucket/prefix`` for
+S3-compatible storage (boto3 when available; tests inject a fake client
+via ``set_s3_client``), ``gs://bucket/prefix`` for Google Cloud Storage
+(from-scratch JSON-API client over urllib; tests inject via
+``set_gcs_client``). Directory-shaped operations (listdir/isdir/rmtree)
+are emulated with delimiter listings, mirroring how the reference treats
 checkpoint paths as key prefixes.
 
 All writes are atomic-publish: local files go through tmp + os.replace,
-S3 puts are atomic by the service's semantics.
+object-store puts are atomic by the services' semantics. S3 writes above
+``storage.multipart-threshold-bytes`` (default 8 MiB) go through the
+multipart API (lib.rs:317 analog) with abort-on-error.
 """
 
 from __future__ import annotations
@@ -23,6 +27,9 @@ from typing import Optional
 _log = logging.getLogger("arroyo_tpu.storage")
 
 _s3_client = None
+_gcs_client = None
+
+MULTIPART_DEFAULT = 8 * 1024 * 1024
 
 
 def set_s3_client(client) -> None:
@@ -30,6 +37,13 @@ def set_s3_client(client) -> None:
     configured boto3 client to control credentials/endpoints)."""
     global _s3_client
     _s3_client = client
+
+
+def set_gcs_client(client) -> None:
+    """Inject a GCS client with the GcsHttpClient surface (download/upload/
+    list/delete/exists); tests pass an in-memory fake."""
+    global _gcs_client
+    _gcs_client = client
 
 
 def _get_s3():
@@ -47,10 +61,129 @@ def _get_s3():
     return _s3_client
 
 
+def _get_gcs():
+    global _gcs_client
+    if _gcs_client is None:
+        _gcs_client = GcsHttpClient()
+    return _gcs_client
+
+
+class GcsHttpClient:
+    """Minimal GCS JSON-API client over urllib (reference GCS backend,
+    arroyo-storage lib.rs:192). Auth: bearer token from
+    GOOGLE_OAUTH_ACCESS_TOKEN or the GCE metadata server; anonymous
+    otherwise (public buckets / emulators). Endpoint overridable for
+    fake-gcs-server style emulators via STORAGE_EMULATOR_HOST."""
+
+    def __init__(self, endpoint: Optional[str] = None, timeout: float = 20.0):
+        self.endpoint = (endpoint or os.environ.get("STORAGE_EMULATOR_HOST")
+                         or "https://storage.googleapis.com").rstrip("/")
+        self.timeout = timeout
+        self._token: Optional[str] = os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN")
+        self._probed_metadata = False
+
+    def _headers(self) -> dict:
+        if self._token is None and not self._probed_metadata:
+            # probe the metadata server ONCE; off-GCE hosts must not pay a
+            # 2s timeout per storage operation
+            self._probed_metadata = True
+            self._metadata_token()
+        return {"Authorization": f"Bearer {self._token}"} if self._token else {}
+
+    def _metadata_token(self) -> Optional[str]:
+        import json as _json
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                "http://metadata.google.internal/computeMetadata/v1/instance/"
+                "service-accounts/default/token",
+                headers={"Metadata-Flavor": "Google"})
+            with urllib.request.urlopen(req, timeout=2) as r:
+                self._token = _json.loads(r.read())["access_token"]
+                return self._token
+        except Exception:  # noqa: BLE001 - not on GCE
+            return None
+
+    def _call(self, method: str, url: str, data: Optional[bytes] = None,
+              content_type: Optional[str] = None) -> bytes:
+        import urllib.request
+
+        headers = self._headers()
+        if content_type:
+            headers["Content-Type"] = content_type
+        req = urllib.request.Request(url, data=data, method=method, headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return r.read()
+
+    @staticmethod
+    def _q(name: str) -> str:
+        import urllib.parse
+
+        return urllib.parse.quote(name, safe="")
+
+    def download(self, bucket: str, name: str) -> bytes:
+        return self._call(
+            "GET", f"{self.endpoint}/storage/v1/b/{bucket}/o/{self._q(name)}?alt=media")
+
+    def upload(self, bucket: str, name: str, data: bytes) -> None:
+        self._call(
+            "POST",
+            f"{self.endpoint}/upload/storage/v1/b/{bucket}/o"
+            f"?uploadType=media&name={self._q(name)}",
+            data=data, content_type="application/octet-stream")
+
+    def delete(self, bucket: str, name: str) -> None:
+        self._call(
+            "DELETE", f"{self.endpoint}/storage/v1/b/{bucket}/o/{self._q(name)}")
+
+    def exists(self, bucket: str, name: str) -> bool:
+        import urllib.error
+
+        try:
+            self._call(
+                "GET", f"{self.endpoint}/storage/v1/b/{bucket}/o/{self._q(name)}")
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise
+
+    def list(self, bucket: str, prefix: str,
+             delimiter: Optional[str] = None) -> tuple[list[str], list[str]]:
+        """(object names, sub-prefixes) under prefix, paginated."""
+        import json as _json
+
+        names: list[str] = []
+        prefixes: list[str] = []
+        token: Optional[str] = None
+        while True:
+            url = (f"{self.endpoint}/storage/v1/b/{bucket}/o"
+                   f"?prefix={self._q(prefix)}")
+            if delimiter:
+                url += f"&delimiter={self._q(delimiter)}"
+            if token:
+                url += f"&pageToken={token}"
+            resp = _json.loads(self._call("GET", url) or b"{}")
+            names.extend(i["name"] for i in resp.get("items", []))
+            prefixes.extend(resp.get("prefixes", []))
+            token = resp.get("nextPageToken")
+            if not token:
+                return names, prefixes
+
+
 def _parse_s3(path: str) -> Optional[tuple[str, str]]:
     if not path.startswith("s3://"):
         return None
     rest = path[len("s3://"):]
+    bucket, _, key = rest.partition("/")
+    return bucket, key.rstrip("/")
+
+
+def _parse_gcs(path: str) -> Optional[tuple[str, str]]:
+    if not path.startswith("gs://"):
+        return None
+    rest = path[len("gs://"):]
     bucket, _, key = rest.partition("/")
     return bucket, key.rstrip("/")
 
@@ -66,14 +199,76 @@ def read_bytes(path: str) -> bytes:
     s3 = _parse_s3(path)
     if s3:
         return _get_s3().get_object(Bucket=s3[0], Key=s3[1])["Body"].read()
+    gcs = _parse_gcs(path)
+    if gcs:
+        return _get_gcs().download(gcs[0], gcs[1])
     with open(_local(path), "rb") as f:
         return f.read()
+
+
+def _multipart_threshold() -> int:
+    from ..config import config
+
+    v = config().get("storage.multipart-threshold-bytes")
+    return int(v) if v is not None else MULTIPART_DEFAULT
+
+
+S3_MIN_PART = 5 * 1024 * 1024  # AWS: every non-final part must be >= 5 MiB
+
+
+def _multipart_part_size() -> int:
+    from ..config import config
+
+    v = config().get("storage.multipart-part-size-bytes")
+    if v is not None:
+        return int(v)
+    # part size decoupled from the trigger threshold: a small threshold
+    # must not produce parts real S3 rejects with EntityTooSmall
+    return max(_multipart_threshold(), S3_MIN_PART)
+
+
+def _s3_multipart_put(client, bucket: str, key: str, data: bytes,
+                      part_size: int) -> None:
+    """Multipart upload with abort-on-error (reference lib.rs:317
+    start/add/close multipart path)."""
+    up = client.create_multipart_upload(Bucket=bucket, Key=key)
+    upload_id = up["UploadId"]
+    try:
+        parts = []
+        num = 1
+        for off in range(0, len(data), part_size):
+            r = client.upload_part(
+                Bucket=bucket, Key=key, UploadId=upload_id, PartNumber=num,
+                Body=data[off:off + part_size])
+            parts.append({"PartNumber": num, "ETag": r["ETag"]})
+            num += 1
+        client.complete_multipart_upload(
+            Bucket=bucket, Key=key, UploadId=upload_id,
+            MultipartUpload={"Parts": parts})
+    except Exception:
+        # never leave a half-finished upload accruing storage charges
+        try:
+            client.abort_multipart_upload(
+                Bucket=bucket, Key=key, UploadId=upload_id)
+        except Exception as e2:  # noqa: BLE001
+            _log.warning("abort_multipart_upload(%s) failed: %s", key, e2)
+        raise
 
 
 def write_bytes(path: str, data: bytes) -> None:
     s3 = _parse_s3(path)
     if s3:
-        _get_s3().put_object(Bucket=s3[0], Key=s3[1], Body=data)
+        client = _get_s3()
+        threshold = _multipart_threshold()
+        if (len(data) > threshold
+                and hasattr(client, "create_multipart_upload")):
+            _s3_multipart_put(client, s3[0], s3[1], data, _multipart_part_size())
+        else:
+            client.put_object(Bucket=s3[0], Key=s3[1], Body=data)
+        return
+    gcs = _parse_gcs(path)
+    if gcs:
+        _get_gcs().upload(gcs[0], gcs[1], data)
         return
     p = _local(path)
     tmp = p + ".tmp"
@@ -94,7 +289,7 @@ def write_text(path: str, text: str) -> None:
 
 
 def makedirs(path: str) -> None:
-    if _parse_s3(path):
+    if _parse_s3(path) or _parse_gcs(path):
         return  # prefixes need no creation
     os.makedirs(_local(path), exist_ok=True)
 
@@ -123,6 +318,9 @@ def exists(path: str) -> bool:
             if _is_not_found(e):
                 return False
             raise
+    gcs = _parse_gcs(path)
+    if gcs:
+        return _get_gcs().exists(gcs[0], gcs[1])
     return os.path.exists(_local(path))
 
 
@@ -133,6 +331,10 @@ def isdir(path: str) -> bool:
         resp = _get_s3().list_objects_v2(
             Bucket=bucket, Prefix=key + "/", MaxKeys=1)
         return resp.get("KeyCount", len(resp.get("Contents", []))) > 0
+    gcs = _parse_gcs(path)
+    if gcs:
+        names, prefixes = _get_gcs().list(gcs[0], gcs[1] + "/")
+        return bool(names or prefixes)
     return os.path.isdir(_local(path))
 
 
@@ -157,6 +359,14 @@ def listdir(path: str) -> list[str]:
             if not token:
                 break
         return sorted(n for n in names if n)
+    gcs = _parse_gcs(path)
+    if gcs:
+        bucket, key = gcs
+        prefix = key + "/" if key else ""
+        onames, oprefixes = _get_gcs().list(bucket, prefix, delimiter="/")
+        out = {n[len(prefix):] for n in onames}
+        out.update(p[len(prefix):].rstrip("/") for p in oprefixes)
+        return sorted(n for n in out if n)
     return sorted(os.listdir(_local(path)))
 
 
@@ -164,6 +374,10 @@ def remove(path: str) -> None:
     s3 = _parse_s3(path)
     if s3:
         _get_s3().delete_object(Bucket=s3[0], Key=s3[1])
+        return
+    gcs = _parse_gcs(path)
+    if gcs:
+        _get_gcs().delete(gcs[0], gcs[1])
         return
     os.remove(_local(path))
 
@@ -212,5 +426,25 @@ def rmtree(path: str) -> None:
                 break
         if errors:
             _log.warning("rmtree(%s): %d delete batch(es) failed", path, errors)
+        return
+    gcs = _parse_gcs(path)
+    if gcs:
+        bucket, key = gcs
+        client = _get_gcs()
+        try:
+            names, _prefixes = client.list(bucket, key + "/")
+        except Exception as e:  # noqa: BLE001
+            _log.warning("rmtree(%s): list failed, sweep aborted: %s", path, e)
+            return
+        errors = 0
+        for n in names:
+            try:
+                client.delete(bucket, n)
+            except Exception as e:  # noqa: BLE001
+                errors += 1
+                if errors <= 3:
+                    _log.warning("rmtree(%s): delete %s failed: %s", path, n, e)
+        if errors:
+            _log.warning("rmtree(%s): %d delete(s) failed", path, errors)
         return
     shutil.rmtree(_local(path), ignore_errors=True)

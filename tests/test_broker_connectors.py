@@ -617,9 +617,14 @@ def test_rabbitmq_source_roundtrip(_storage):
         while len(rows) < 25 and time.monotonic() < deadline:
             time.sleep(0.1)
         assert sorted(r["v"] for r in rows) == list(range(25))
-        # at-least-once: every delivery was acked back
-        time.sleep(0.2)
-        assert len(broker.acked) >= 25
+        # at-least-once: acks DEFER until a checkpoint covers the messages
+        # (a crash before the barrier leaves them unacked for redelivery)
+        assert len(broker.acked) == 0
+        assert eng.checkpoint_and_wait(1, timeout=30)
+        deadline = time.monotonic() + 10
+        while len(broker.acked) < 25 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(broker.acked) == 25
     finally:
         eng.stop()
         eng.join(timeout=30)

@@ -90,6 +90,79 @@ INSERT INTO snk SELECT x FROM src WHERE x % 2 = 0;
         api.stop()
 
 
+def test_connection_table_crud_and_sql_by_name(tmp_path, _storage):
+    """Connection tables registered over REST are usable in pipeline SQL by
+    name with no inline DDL (reference rest.rs:144-158 CRUD +
+    ArroyoSchemaProvider registration)."""
+    import time
+
+    from arroyo_tpu.api import ApiServer
+    from arroyo_tpu.api.client import ApiError, ArroyoClient
+    from arroyo_tpu.controller import ControllerServer, Database
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+
+    inp = tmp_path / "in.json"
+    with open(inp, "w") as f:
+        for i in range(20):
+            f.write(json.dumps({"x": i, "timestamp": i * 1000}) + "\n")
+    out_path = tmp_path / "out.json"
+    db = Database()
+    api = ApiServer(db).start()
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+    try:
+        c = ArroyoClient(f"http://127.0.0.1:{api.port}")
+        # profile holds shared options; table overrides/extends them
+        prof = c.create_connection_profile("files", "single_file",
+                                           {"format": "json"})
+        t = c.test_connection_table(
+            name="events", connector="single_file", table_type="source",
+            schema_fields=[{"name": "timestamp", "type": "TIMESTAMP"},
+                           {"name": "x", "type": "BIGINT"}])
+        assert t["ok"], t
+        bad = c.test_connection_table(name="b", connector="nope")
+        assert not bad["ok"] and "unknown source connector" in bad["error"]
+        src = c.create_connection_table(
+            "events", "single_file", "source",
+            config={"path": str(inp), "event_time_field": "timestamp"},
+            schema_fields=[{"name": "timestamp", "type": "TIMESTAMP"},
+                           {"name": "x", "type": "BIGINT"}],
+            profile_id=prof["id"])
+        snk = c.create_connection_table(
+            "out_events", "single_file", "sink",
+            config={"path": str(out_path)},
+            schema_fields=[{"name": "x", "type": "BIGINT"}],
+            profile_id=prof["id"])
+        names = [t["name"] for t in c.list_connection_tables()]
+        assert names == ["events", "out_events"]
+        # profile config merged in (format riding from the profile)
+        assert all(t["config"]["format"] == "json"
+                   for t in c.list_connection_tables())
+
+        # SQL references both by NAME — no CREATE TABLE anywhere
+        sql = "INSERT INTO out_events SELECT x FROM events WHERE x < 10;"
+        assert c.validate_query(sql)["valid"]
+        r = c.create_pipeline(sql, name="ct-pipe")
+        job = c.run_to_state(r["job_id"], "Finished")
+        assert job["state"] == "Finished"
+        rows = [json.loads(l) for l in open(out_path)]
+        assert sorted(row["x"] for row in rows) == list(range(10))
+
+        # a profile referenced by tables cannot be deleted
+        try:
+            c.delete_connection_profile(prof["id"])
+            raise AssertionError("expected 409")
+        except ApiError as e:
+            assert e.status == 409
+        c.delete_connection_table(src["id"])
+        c.delete_connection_table(snk["id"])
+        c.delete_connection_profile(prof["id"])
+        assert c.list_connection_tables() == []
+        assert not c.validate_query(sql)["valid"]  # tables gone from scope
+    finally:
+        ctl.stop()
+        api.stop()
+
+
 def test_webui_served():
     from arroyo_tpu.api import ApiServer
     from arroyo_tpu.controller import Database

@@ -49,12 +49,87 @@ class FakeS3:
         }
 
 
+class FakeMultipartS3(FakeS3):
+    """FakeS3 plus the multipart API: uploads assemble from parts and a
+    failure mid-part must abort (no half-object visible)."""
+
+    def __init__(self):
+        super().__init__()
+        self.uploads: dict[str, dict] = {}
+        self.multipart_completed = 0
+        self.aborted = 0
+        self.fail_part: int | None = None
+
+    def create_multipart_upload(self, Bucket, Key):
+        uid = f"up-{len(self.uploads)}"
+        self.uploads[uid] = {"bucket": Bucket, "key": Key, "parts": {}}
+        return {"UploadId": uid}
+
+    def upload_part(self, Bucket, Key, UploadId, PartNumber, Body):
+        if self.fail_part == PartNumber:
+            raise RuntimeError("injected part failure")
+        self.uploads[UploadId]["parts"][PartNumber] = bytes(Body)
+        return {"ETag": f"etag-{PartNumber}"}
+
+    def complete_multipart_upload(self, Bucket, Key, UploadId, MultipartUpload):
+        up = self.uploads.pop(UploadId)
+        body = b"".join(up["parts"][p["PartNumber"]]
+                        for p in MultipartUpload["Parts"])
+        self.objects[(Bucket, Key)] = body
+        self.multipart_completed += 1
+
+    def abort_multipart_upload(self, Bucket, Key, UploadId):
+        self.uploads.pop(UploadId, None)
+        self.aborted += 1
+
+
+class FakeGcs:
+    """In-memory client with the GcsHttpClient surface."""
+
+    def __init__(self):
+        self.objects: dict[tuple[str, str], bytes] = {}
+
+    def download(self, bucket, name):
+        if (bucket, name) not in self.objects:
+            raise FileNotFoundError(name)
+        return self.objects[(bucket, name)]
+
+    def upload(self, bucket, name, data):
+        self.objects[(bucket, name)] = bytes(data)
+
+    def delete(self, bucket, name):
+        self.objects.pop((bucket, name), None)
+
+    def exists(self, bucket, name):
+        return (bucket, name) in self.objects
+
+    def list(self, bucket, prefix, delimiter=None):
+        names, prefixes = [], set()
+        for (b, n) in sorted(self.objects):
+            if b != bucket or not n.startswith(prefix):
+                continue
+            rest = n[len(prefix):]
+            if delimiter and delimiter in rest:
+                prefixes.add(prefix + rest.split(delimiter)[0] + delimiter)
+            else:
+                names.append(n)
+        return names, sorted(prefixes)
+
+
 @pytest.fixture
 def fake_s3():
     client = FakeS3()
     storage.set_s3_client(client)
     yield client
     storage.set_s3_client(None)
+
+
+@pytest.fixture
+def fake_gcs():
+    client = FakeGcs()
+    storage.set_gcs_client(client)
+    yield client
+    storage.set_gcs_client(None)
 
 
 def test_s3_bytes_listing_roundtrip(fake_s3):
@@ -70,6 +145,73 @@ def test_s3_bytes_listing_roundtrip(fake_s3):
     assert storage.listdir("s3://bkt/a") == ["b"]
     storage.rmtree("s3://bkt/a")
     assert not storage.isdir("s3://bkt/a")
+
+
+def test_gcs_bytes_listing_roundtrip(fake_gcs):
+    storage.write_bytes("gs://bkt/a/b/file.bin", b"hello")
+    storage.write_text("gs://bkt/a/other.txt", "world")
+    assert storage.read_bytes("gs://bkt/a/b/file.bin") == b"hello"
+    assert storage.read_text("gs://bkt/a/other.txt") == "world"
+    assert storage.exists("gs://bkt/a/other.txt")
+    assert not storage.exists("gs://bkt/a/missing")
+    assert storage.isdir("gs://bkt/a") and storage.isdir("gs://bkt/a/b")
+    assert storage.listdir("gs://bkt/a") == ["b", "other.txt"]
+    storage.remove("gs://bkt/a/other.txt")
+    assert storage.listdir("gs://bkt/a") == ["b"]
+    storage.rmtree("gs://bkt/a")
+    assert not storage.isdir("gs://bkt/a")
+
+
+def test_checkpoint_roundtrip_on_fake_gcs(fake_gcs):
+    """Full state checkpoint/restore over gs:// paths (same flow as the S3
+    test): the TableManager only sees the storage API."""
+    from arroyo_tpu.batch import Batch, KEY_FIELD, TIMESTAMP_FIELD
+    from arroyo_tpu.state.tables import TableManager
+    from arroyo_tpu.types import TaskInfo
+
+    from arroyo_tpu.operators.base import TableSpec
+
+    ti = TaskInfo("jg", "op", "operator", 0, 1)
+    tm = TableManager(ti, "gs://ckpt/jobs")
+    tbl = tm.global_keyed("g")
+    tbl.insert("k1", {"x": 1})
+    tm.checkpoint(1, None)
+    tm2 = TableManager(TaskInfo("jg", "op", "operator", 0, 1), "gs://ckpt/jobs")
+    tm2.restore(1, [TableSpec("g", "global_keyed")])
+    assert dict(tm2.global_keyed("g").items())["k1"] == {"x": 1}
+
+
+def test_s3_multipart_write_and_abort(fake_s3, monkeypatch):
+    """Writes above the threshold go through multipart (parts reassemble
+    byte-exact); a failing part aborts the upload leaving no object."""
+    client = FakeMultipartS3()
+    storage.set_s3_client(client)
+    from arroyo_tpu import config as cfg
+
+    cfg.update({"storage.multipart-threshold-bytes": 1024,
+                "storage.multipart-part-size-bytes": 1024})
+    try:
+        small = b"s" * 100
+        storage.write_bytes("s3://bkt/small.bin", small)
+        assert client.multipart_completed == 0  # under threshold: plain put
+        big = bytes(range(256)) * 20  # 5120 bytes -> 5 parts at 1024
+        storage.write_bytes("s3://bkt/big.bin", big)
+        assert client.multipart_completed == 1
+        assert storage.read_bytes("s3://bkt/big.bin") == big
+        # failure mid-part: abort, no partial object, no leaked upload
+        client.fail_part = 3
+        with pytest.raises(RuntimeError, match="injected part failure"):
+            storage.write_bytes("s3://bkt/fail.bin", big)
+        assert client.aborted == 1
+        assert not client.uploads
+        assert not storage.exists("s3://bkt/fail.bin")
+        # with no explicit part size, parts never go below the S3 minimum
+        cfg.update({"storage.multipart-part-size-bytes": None})
+        assert storage._multipart_part_size() == storage.S3_MIN_PART
+    finally:
+        cfg.update({"storage.multipart-threshold-bytes": None,
+                    "storage.multipart-part-size-bytes": None})
+        storage.set_s3_client(None)
 
 
 def test_local_write_is_atomic_publish(tmp_path):
