@@ -71,6 +71,10 @@ class ArroyoClient:
     def pipeline_jobs(self, pipeline_id: str) -> list[dict]:
         return self._req("GET", f"/api/v1/pipelines/{pipeline_id}/jobs")["data"]
 
+    def pipeline_graph(self, pipeline_id: str) -> dict:
+        """Planned dataflow DAG: {nodes: [...], edges: [...]}."""
+        return self._req("GET", f"/api/v1/pipelines/{pipeline_id}/graph")
+
     def list_jobs(self) -> list[dict]:
         return self._req("GET", "/api/v1/jobs")["data"]
 

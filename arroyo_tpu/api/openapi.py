@@ -117,6 +117,19 @@ def spec() -> dict:
                            response=PIPELINE),
                 "delete": _op("delete_pipeline", "delete pipeline + jobs",
                               ["pipeline_id"])},
+            "/api/v1/pipelines/{pipeline_id}/graph": {
+                "get": _op("pipeline_graph", "planned dataflow DAG",
+                           ["pipeline_id"],
+                           response={"type": "object", "properties": {
+                               "nodes": {"type": "array", "items": {
+                                   "type": "object", "properties": {
+                                       "id": _STR, "op": _STR,
+                                       "description": _STR,
+                                       "parallelism": _INT}}},
+                               "edges": {"type": "array", "items": {
+                                   "type": "object", "properties": {
+                                       "src": _STR, "dst": _STR,
+                                       "type": _STR}}}}})},
             "/api/v1/pipelines/{pipeline_id}/jobs": {
                 "get": _op("pipeline_jobs", "jobs of a pipeline", ["pipeline_id"],
                            response={"type": "object",

@@ -77,6 +77,7 @@ class ApiServer:
 
     _ROUTES = [
         ("GET", r"^/$", "_webui"),
+        ("GET", r"^/webui/([A-Za-z0-9_.-]+)$", "_webui_asset"),
         ("GET", r"^/api/v1/openapi\.json$", "_openapi"),
         ("GET", r"^/api/v1/ping$", "_ping"),
         ("POST", r"^/api/v1/pipelines/validate$", "_validate"),
@@ -85,6 +86,7 @@ class ApiServer:
         ("GET", r"^/api/v1/pipelines/([^/]+)$", "_get_pipeline"),
         ("DELETE", r"^/api/v1/pipelines/([^/]+)$", "_delete_pipeline"),
         ("GET", r"^/api/v1/pipelines/([^/]+)/jobs$", "_pipeline_jobs"),
+        ("GET", r"^/api/v1/pipelines/([^/]+)/graph$", "_pipeline_graph"),
         ("GET", r"^/api/v1/jobs$", "_list_jobs"),
         ("GET", r"^/api/v1/jobs/([^/]+)$", "_get_job"),
         ("PATCH", r"^/api/v1/jobs/([^/]+)$", "_patch_job"),
@@ -131,18 +133,36 @@ class ApiServer:
 
         h._json(200, spec())
 
-    def _webui(self, h):
+    _WEBUI_TYPES = {".html": "text/html; charset=utf-8",
+                    ".js": "text/javascript; charset=utf-8",
+                    ".css": "text/css; charset=utf-8",
+                    ".svg": "image/svg+xml"}
+
+    def _serve_webui_file(self, h, name: str) -> None:
         import os
 
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "webui", "index.html")
+        base = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "webui")
+        path = os.path.join(base, name)
+        # route regex forbids path separators; keep the normpath guard anyway
+        if not os.path.normpath(path).startswith(base) or not os.path.isfile(path):
+            h._json(404, {"error": f"no asset {name!r}"})
+            return
         with open(path, "rb") as f:
             data = f.read()
+        ext = os.path.splitext(name)[1]
         h.send_response(200)
-        h.send_header("Content-Type", "text/html; charset=utf-8")
+        h.send_header("Content-Type",
+                      self._WEBUI_TYPES.get(ext, "application/octet-stream"))
         h.send_header("Content-Length", str(len(data)))
         h.end_headers()
         h.wfile.write(data)
+
+    def _webui(self, h):
+        self._serve_webui_file(h, "index.html")
+
+    def _webui_asset(self, h, name):
+        self._serve_webui_file(h, name)
 
     def _activate_udfs(self) -> None:
         from ..compiler import activate_udf_specs
@@ -272,6 +292,42 @@ class ApiServer:
 
     def _pipeline_jobs(self, h, pid):
         h._json(200, {"data": self.db.list_jobs(pid)})
+
+    def _pipeline_graph(self, h, pid):
+        """Planned dataflow DAG for the UI's graph view (reference
+        PipelineGraph.tsx consumes the pipeline's edges/nodes)."""
+        from ..sql import plan_query
+        from ..sql.lexer import SqlError
+
+        p = self.db.get_pipeline(pid)
+        if not p:
+            h._json(404, {"error": "not found"})
+            return
+        try:
+            self._activate_udfs()
+            pp = plan_query(p["query"],
+                            connection_tables=self.db.list_connection_tables())
+            par = int(p.get("parallelism") or 1)
+            if par > 1:
+                # show the DAG as it executes, not the p=1 plan
+                from ..sql.planner import set_parallelism
+
+                set_parallelism(pp.graph, par)
+        except SqlError as e:
+            h._json(400, {"error": str(e)})
+            return
+        g = pp.graph
+        nodes = [
+            {"id": n.node_id, "op": n.op.value,
+             "description": n.description or n.op.value,
+             "parallelism": n.parallelism}
+            for n in g.nodes.values()
+        ]
+        edges = [
+            {"src": e.src, "dst": e.dst, "type": e.edge_type.value}
+            for e in g.edges
+        ]
+        h._json(200, {"nodes": nodes, "edges": edges})
 
     def _list_jobs(self, h):
         h._json(200, {"data": self.db.list_jobs()})

@@ -105,6 +105,10 @@ class MetricsRegistry:
                 "subtasks": 0,
                 **dict.fromkeys(_COUNTER_NAMES, 0),
                 "backpressure": 0.0,
+                # rate is overwritten by the controller's windowed tracker
+                # while the job runs; a terminal snapshot reports 0 so the
+                # field contract holds for every consumer (UI charts)
+                "messages_per_sec": 0.0,
             })
             op["subtasks"] += 1
             for name in _COUNTER_NAMES:
