@@ -216,39 +216,45 @@ class InstantJoin(Operator):
             lk = lb.keys.astype(np.uint64).view(np.int64)
             rk = rb.keys.astype(np.uint64).view(np.int64)
             li, ri = _hash_join_indices(lk, rk)
-        out = []
         if len(li):
-            out.append((lb.take(li), rb.take(ri)))
+            self._emit(t, lb, rb, li, ri, collector)
         if jt in ("left", "full"):
             unmatched = np.ones(lb.num_rows, dtype=bool)
             unmatched[li] = False
             if unmatched.any():
-                out.append((lb.filter(unmatched), None))
+                self._emit(t, lb.filter(unmatched), None, None, None, collector)
         if jt in ("right", "full"):
             unmatched = np.ones(rb.num_rows, dtype=bool)
             unmatched[ri] = False
             if unmatched.any():
-                out.append((None, rb.filter(unmatched)))
-        for lpart, rpart in out:
-            self._emit(t, lpart, rpart, None, None, collector)
+                self._emit(t, None, rb.filter(unmatched), None, None, collector)
 
-    def _emit(self, t, lb, rb, _l, _r, collector) -> None:
-        n = lb.num_rows if lb is not None else rb.num_rows
+    def _emit(self, t, lb, rb, li, ri, collector) -> None:
+        """One output batch. With index arrays (matched-pair path) only the
+        PROJECTED columns are gathered — Batch.take would copy every column
+        including internals, doubling the close cost of a wide expansion."""
+        if li is not None:
+            n = len(li)
+        else:
+            n = lb.num_rows if lb is not None else rb.num_rows
         cols: dict[str, np.ndarray] = {}
         for out_name, src in self.left_names:
-            if lb is not None:
-                cols[out_name] = lb[src]
-            else:
+            if lb is None:
                 cols[out_name] = _object_col([None] * n)
+            else:
+                col = np.asarray(lb[src])
+                cols[out_name] = col[li] if li is not None else col
         for out_name, src in self.right_names:
-            if rb is not None:
-                cols[out_name] = rb[src]
-            else:
+            if rb is None:
                 cols[out_name] = _object_col([None] * n)
+            else:
+                col = np.asarray(rb[src])
+                cols[out_name] = col[ri] if ri is not None else col
         cols[TIMESTAMP_FIELD] = np.full(n, t, dtype=np.int64)
         src_keys = lb if lb is not None else rb
         if KEY_FIELD in src_keys:
-            cols[KEY_FIELD] = src_keys.keys
+            k = np.asarray(src_keys.keys)
+            cols[KEY_FIELD] = k[li] if (lb is not None and li is not None) else k
         collector.collect(Batch(cols))
 
     def handle_checkpoint(self, barrier, ctx, collector):

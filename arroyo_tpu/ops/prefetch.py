@@ -39,7 +39,7 @@ def wait_buffers_ready(bufs, deadline_s: float = 30.0) -> None:
             while not buf.is_ready():
                 if time.monotonic() > limit:
                     return
-                time.sleep(0.001)
+                time.sleep(0.0002)
     except AttributeError:
         return  # backend without is_ready: fall through to asarray
 

@@ -53,11 +53,18 @@ class SlidingAggregate(Operator):
         self.final_projection = cfg.get("final_projection")
         dtype_of = dtype_of_from_config(cfg)
         self.acc_kinds, self.acc_dtypes, self.acc_inputs = acc_plan(self.aggregates, dtype_of)
+        self.n_user_accs = len(self.acc_kinds)
         self.backend = cfg.get("backend") or (
             "jax" if config().get("device.enabled") else "numpy"
         )
         self._agg = None
-        self.key_dict = KeyDictionary(self.key_fields)
+        # key transport split (same as tumbling): numeric group-by columns
+        # ride the aggregate store as extra max-lanes — every row of a key
+        # holds the same value — so only non-numeric keys pay the host
+        # KeyDictionary's per-key Python cost
+        self.lane_key_fields: Optional[list[str]] = None
+        self.dict_key_fields: list[str] = []
+        self.key_dict = KeyDictionary([])
         self.base_bin: Optional[int] = None  # abs slide-bin offset
         self.min_bin: Optional[int] = None  # earliest live rel bin
         self.max_bin: Optional[int] = None  # latest rel bin seen
@@ -92,6 +99,23 @@ class SlidingAggregate(Operator):
                 self.acc_kinds, self.acc_dtypes, self.backend)
         return self._agg
 
+    def _setup_key_transport(self, batch: Batch) -> None:
+        lane, dicty = [], []
+        for f in self.key_fields:
+            col = np.asarray(batch[f])
+            if np.issubdtype(col.dtype, np.integer) or np.issubdtype(col.dtype, np.floating):
+                lane.append((f, col.dtype))
+            else:
+                dicty.append(f)
+        self.lane_key_fields = [f for f, _ in lane]
+        self.dict_key_fields = dicty
+        self.key_dict = KeyDictionary(dicty)
+        from ..expr import Col
+
+        self.acc_kinds = self.acc_kinds + tuple("max" for _ in lane)
+        self.acc_dtypes = self.acc_dtypes + tuple(np.dtype(d) for _, d in lane)
+        self.acc_inputs = self.acc_inputs + tuple(Col(f) for f, _ in lane)
+
     def on_start(self, ctx):
         tbl = ctx.table_manager.expiring_time_key("t", self.width)
         batches = tbl.all_batches()
@@ -100,11 +124,17 @@ class SlidingAggregate(Operator):
             tbl.replace_all([])
 
     def _restore_from_batch(self, b: Batch) -> None:
+        if self.lane_key_fields is None:
+            self._setup_key_transport(b)
         hashes = b.keys.astype(np.uint64)
         bins_abs = b.timestamps // self.slide
         self.base_bin = int(bins_abs.min())
         rel = (bins_abs - self.base_bin).astype(np.int32)
-        accs = [b[f"__acc_{i}"].astype(d) for i, d in enumerate(self.acc_dtypes)]
+        accs = [b[f"__acc_{i}"].astype(d)
+                for i, d in enumerate(self.acc_dtypes[: self.n_user_accs])]
+        accs += [np.asarray(b[f]).astype(d)
+                 for f, d in zip(self.lane_key_fields,
+                                 self.acc_dtypes[self.n_user_accs:])]
         self._aggregator().restore(hashes, rel, accs)
         self.open_bins = set(np.unique(rel).tolist())
         self.min_bin = int(rel.min())
@@ -123,6 +153,8 @@ class SlidingAggregate(Operator):
     def process_batch(self, batch, ctx, collector, input_index=0):
         if self._bin_pending or self._wm_queue:
             self._drain(collector)
+        if self.lane_key_fields is None:
+            self._setup_key_transport(batch)
         ts = batch.timestamps
         bins_abs = ts // self.slide
         if self.base_bin is None:
@@ -327,10 +359,14 @@ class SlidingAggregate(Operator):
         start = (start_rel + self.base_bin) * self.slide
         n = len(keys)
         cols: dict[str, np.ndarray] = {}
-        cols.update(self.key_dict.lookup_columns(keys))
+        if self.dict_key_fields:
+            cols.update(self.key_dict.lookup_columns(keys))
+        for f, lane in zip(self.lane_key_fields or [], accs[self.n_user_accs:]):
+            cols[f] = lane
         cols[WINDOW_START] = np.full(n, start, dtype=np.int64)
         cols[WINDOW_END] = np.full(n, start + self.width, dtype=np.int64)
-        finals = finalize_aggs([a[1] for a in self.aggregates], accs)
+        finals = finalize_aggs([a[1] for a in self.aggregates],
+                               accs[: self.n_user_accs])
         for (name, _k, _e), arr in zip(self.aggregates, finals):
             cols[name] = arr
         # reference stamps the window start as the output event time (:217)
@@ -351,6 +387,12 @@ class SlidingAggregate(Operator):
         # still feeding future windows — into the snapshot
         self._drain(collector, force=True)
         self._resolve_bins(sorted(self._bin_pending), force=True)
+        tbl = ctx.table_manager.expiring_time_key("t", self.width)
+        if self._agg is None:
+            # no data yet: building the aggregator now would freeze
+            # acc_kinds before _setup_key_transport appends the key lanes
+            tbl.replace_all([])
+            return
         keys, bins, accs = self._aggregator().snapshot()
         cached = sorted(self._bin_cache)
         if cached:
@@ -360,7 +402,6 @@ class SlidingAggregate(Operator):
                           for b in cached])
             accs = [np.concatenate([a] + [self._bin_cache[b][1][i] for b in cached])
                     for i, a in enumerate(accs)]
-        tbl = ctx.table_manager.expiring_time_key("t", self.width)
         if len(keys) == 0:
             tbl.replace_all([])
             return
@@ -372,8 +413,11 @@ class SlidingAggregate(Operator):
                 len(keys), (self.next_window or 0) + (self.base_bin or 0), dtype=np.int64
             ),
         }
-        cols.update(self.key_dict.lookup_columns(keys))
-        for i, a in enumerate(accs):
+        if self.dict_key_fields:
+            cols.update(self.key_dict.lookup_columns(keys))
+        for f, lane in zip(self.lane_key_fields or [], accs[self.n_user_accs:]):
+            cols[f] = lane
+        for i, a in enumerate(accs[: self.n_user_accs]):
             cols[f"__acc_{i}"] = a
         tbl.replace_all([Batch(cols)])
 
