@@ -207,7 +207,7 @@ class ApiServer:
         """Create a UDF: cpp sources compile through the CompileService
         (artifact pushed to storage); python sources are stored and executed
         at plan/worker start (reference: POST /udfs + compiler service)."""
-        from ..compiler import CompileError, CompileService, activate_udf_specs
+        from ..compiler import activate_udf_specs, compile_udf
 
         body = h._body()
         name = body.get("name")
@@ -221,7 +221,8 @@ class ApiServer:
         return_dtype = body.get("return_dtype", "float64")
         try:
             if language == "cpp":
-                spec = CompileService().build_udf(name, source, arg_dtypes, return_dtype)
+                # remote compile service when compiler.endpoint is set
+                spec = compile_udf(name, source, arg_dtypes, return_dtype)
                 artifact = spec.artifact_url
             self.db.create_udf(name, language, source, arg_dtypes, return_dtype, artifact)
             try:

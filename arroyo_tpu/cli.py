@@ -223,6 +223,24 @@ def _cmd_node(args) -> int:
         return 0
 
 
+def _cmd_compile_service(args) -> int:
+    """Standalone UDF compile service (reference `arroyo-compiler-service`):
+    builds cpp UDF sources into dylibs and publishes them to the artifact
+    store; the API delegates here when compiler.endpoint is configured."""
+    from arroyo_tpu.compiler import CompileServer
+
+    srv = CompileServer(host=args.host, port=args.port,
+                        artifacts_url=args.artifacts_url).start()
+    print(f"compile service on :{srv.port} -> {srv.service.artifacts_url}",
+          file=sys.stderr)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        srv.stop()
+        return 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     # Honor JAX_PLATFORMS even where a site-level shim force-selects a
     # platform at interpreter startup (the axon TPU tunnel does this and is
@@ -285,6 +303,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     vp = sub.add_parser("visualize", help="print the dataflow graph as dot")
     vp.add_argument("sql_file")
     vp.set_defaults(fn=_cmd_visualize)
+
+    cs = sub.add_parser("compile-service",
+                        help="standalone native-UDF compile service")
+    cs.add_argument("--port", type=int, default=5117)
+    cs.add_argument("--host", default="0.0.0.0")
+    cs.add_argument("--artifacts-url", default=None,
+                    help="storage prefix for built dylibs (local or s3://)")
+    cs.set_defaults(fn=_cmd_compile_service)
 
     args = p.parse_args(argv)
     return args.fn(args)

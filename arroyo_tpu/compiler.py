@@ -102,6 +102,121 @@ class CompileService:
         return NativeUdfSpec(name, tuple(arg_dtypes), return_dtype, artifact)
 
 
+class CompileServer:
+    """Standalone compile service (reference arroyo-compiler-service
+    lib.rs:57 runs CompileService as its own deployable; here a JSON/HTTP
+    daemon): POST /compile {name, source, arg_dtypes, return_dtype} ->
+    {artifact_url}; GET /status. The API server delegates cpp UDF builds
+    here when ``compiler.endpoint`` is configured, keeping g++ and
+    untrusted source compilation off the control-plane process."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 artifacts_url: Optional[str] = None):
+        import json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        svc = CompileService(artifacts_url)
+        self.service = svc
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code: int, payload) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/status":
+                    self._json(200, {"ok": True,
+                                     "artifacts_url": svc.artifacts_url})
+                else:
+                    self._json(404, {"error": "no route"})
+
+            def do_POST(self):
+                if self.path != "/compile":
+                    self._json(404, {"error": "no route"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    spec = svc.build_udf(
+                        body["name"], body["source"],
+                        list(body.get("arg_dtypes", [])),
+                        body.get("return_dtype", "float64"))
+                except (CompileError, KeyError, TypeError, ValueError) as e:
+                    # bad JSON / bad shape / bad source: the submitter's fault
+                    self._json(400, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 - g++ missing, timeout
+                    # service-side failure: still answer, or the API wraps
+                    # the dropped connection as "unreachable" and the real
+                    # diagnostic is lost
+                    self._json(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._json(200, {
+                    "name": spec.name, "artifact_url": spec.artifact_url,
+                    "arg_dtypes": list(spec.arg_dtypes),
+                    "return_dtype": spec.return_dtype,
+                })
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "CompileServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name=f"compile-service-{self.port}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def compile_udf(name: str, source: str, arg_dtypes: list[str],
+                return_dtype: str) -> NativeUdfSpec:
+    """Build via the remote compile service when ``compiler.endpoint`` is
+    configured, else in-process (reference: the API calls the compiler
+    service over gRPC when deployed, builds locally in dev)."""
+    from .config import config
+
+    endpoint = config().get("compiler.endpoint")
+    if not endpoint:
+        return CompileService().build_udf(name, source, arg_dtypes, return_dtype)
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        str(endpoint).rstrip("/") + "/compile",
+        data=_json.dumps({
+            "name": name, "source": source, "arg_dtypes": arg_dtypes,
+            "return_dtype": return_dtype}).encode(),
+        method="POST", headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=180) as r:
+            out = _json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace")
+        try:
+            detail = _json.loads(detail).get("error", detail)
+        except ValueError:
+            pass
+        raise CompileError(detail) from e
+    except urllib.error.URLError as e:
+        raise CompileError(f"compile service unreachable: {e.reason}") from e
+    return NativeUdfSpec(out["name"], tuple(out["arg_dtypes"]),
+                         out["return_dtype"], out["artifact_url"])
+
+
 # --------------------------------------------------------------- dylib host
 
 _loaded: dict[str, ctypes.CDLL] = {}

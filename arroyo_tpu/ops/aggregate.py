@@ -59,6 +59,10 @@ def finalize_aggs(kinds: Sequence[str], acc_arrays: list[np.ndarray]) -> list[np
             s, c = acc_arrays[i], acc_arrays[i + 1]
             i += 2
             out.append(np.divide(s, np.maximum(c, 1)).astype(np.float64))
+        elif kind == "count_distinct":
+            out.append(np.array([len(set(lst)) for lst in acc_arrays[i]],
+                                dtype=np.int64))
+            i += 1
         elif kind.startswith("udaf:"):
             from ..batch import Field
             from ..udf import lookup_udaf

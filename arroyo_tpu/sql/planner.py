@@ -681,9 +681,25 @@ class Planner:
         agg_rewrites: list[tuple[SqlExpr, SqlExpr]] = []
         agg_out_dtypes: dict[str, str] = {}
         for i, a in enumerate(uniq_aggs):
-            if a.distinct:
-                raise PlanError("DISTINCT aggregates are unsupported")
             out = f"__agg_{i}"
+            if a.distinct:
+                # COUNT(DISTINCT x) rides the collect machinery (session +
+                # tumbling windows); other DISTINCT aggregates and updating
+                # inputs (retractions need per-value multiplicities) remain
+                # out of scope, like the reference's datafusion fork
+                if a.name != "count" or a.star or len(a.args) != 1:
+                    raise PlanError(
+                        "only COUNT(DISTINCT expr) is supported among "
+                        "DISTINCT aggregates")
+                if rel.updating:
+                    raise PlanError(
+                        "COUNT(DISTINCT) over an updating input is "
+                        "unsupported")
+                e = compile_expr(a.args[0], rel.scope)
+                aggregates.append((out, "count_distinct", e))
+                agg_out_dtypes[out] = "int64"
+                agg_rewrites.append((a, Ident(out)))
+                continue
             if rel.updating and a.name in ("min", "max"):
                 # reject at plan time: retractions need invertible
                 # accumulators (sum/count/avg); min/max would crash at the
@@ -779,15 +795,20 @@ class Planner:
             agg_cfg["gap_micros"] = window.gap
         if rel.updating and window is not None:
             raise PlanError("windowed aggregates over updating inputs are unsupported")
-        has_collect = any(k.startswith("udaf:") or k == "collect"
+        has_collect = any(k.startswith("udaf:") or k in ("collect", "count_distinct")
                           for _n, k, _e in aggregates)
         if has_collect and op not in (OpName.SESSION_AGGREGATE,
                                       OpName.TUMBLING_AGGREGATE):
             # collected values are host-resident python lists; the sliding
             # path's partial-combine arithmetic and the updating path's
             # retractions have no list analog
+            offenders = sorted({
+                "COUNT(DISTINCT)" if k == "count_distinct"
+                else "array_agg" if k == "collect" else k[5:] + "()"
+                for _n, k, _e in aggregates
+                if k.startswith("udaf:") or k in ("collect", "count_distinct")})
             raise PlanError(
-                "array_agg/UDAFs are supported in session and tumbling "
+                f"{', '.join(offenders)} supported in session and tumbling "
                 "windows only")
         if has_collect and op == OpName.TUMBLING_AGGREGATE:
             # object lanes cannot ride HBM; force the host aggregator

@@ -207,9 +207,10 @@ def acc_plan(aggregates: list[tuple[str, str, Optional[Expr]]], schema_dtype_of)
             kinds.extend(["sum", "count"])
             dtypes.extend([np.dtype(np.float64), np.dtype(np.int64)])
             inputs.extend([expr, None])
-        elif kind.startswith("udaf:") or kind == "collect":
-            # UDAF state / array_agg = collected input values (host-resident
-            # python lists; planner allows session + tumbling windows)
+        elif kind.startswith("udaf:") or kind in ("collect", "count_distinct"):
+            # UDAF state / array_agg / COUNT(DISTINCT) = collected input
+            # values (host-resident python lists; planner allows session +
+            # tumbling windows)
             kinds.append("collect")
             dtypes.append(np.dtype(object))
             inputs.append(expr)

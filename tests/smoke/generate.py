@@ -458,6 +458,20 @@ def o_async_udf(ins):
     return [{"counter": -2 * r["counter"]} for r in ins["impulse"]]
 
 
+def o_count_distinct(ins):
+    W = 20 * S
+    groups = defaultdict(lambda: (set(), 0))
+    for r in ins["cars"]:
+        w = tumble_start(input_ts(r, "timestamp"), W)
+        drivers, n = groups[(w, r["event_type"])]
+        drivers.add(r["driver_id"])
+        groups[(w, r["event_type"])] = (drivers, n + 1)
+    return [
+        {"start": iso(w), "et": et, "drivers": len(d), "events": n}
+        for (w, et), (d, n) in sorted(groups.items())
+    ]
+
+
 def o_memory_table(ins):
     return [{"driver_id": r["driver_id"], "event_type": r["event_type"]}
             for r in ins["cars"]]
@@ -649,6 +663,7 @@ ORACLES = {
     "updating_inner_join_with_updating": o_updating_inner_join_with_updating,
     "async_udf": o_async_udf,
     "memory_table": o_memory_table,
+    "count_distinct": o_count_distinct,
     "offset_impulse_join": o_offset_impulse_join,
     "unnest_in_view": o_unnest_in_view,
     "json_operators": o_json_operators,

@@ -1,0 +1,32 @@
+-- COUNT(DISTINCT x) in a tumbling window via the collect machinery
+-- (reference datafusion count distinct; debezium_agg uses the same shape).
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE distinct_output (
+  start TIMESTAMP,
+  et TEXT,
+  drivers BIGINT,
+  events BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO distinct_output
+SELECT x.w.start, x.et, x.drivers, x.events FROM (
+  SELECT tumble(interval '20 seconds') AS w, event_type AS et,
+         count(DISTINCT driver_id) AS drivers, count(*) AS events
+  FROM cars
+  GROUP BY w, et
+) x;

@@ -123,3 +123,65 @@ INSERT INTO snk SELECT x, scale7(x) AS y FROM src;
         ctl.stop()
         api.stop()
         drop_udf("scale7")
+
+
+def test_standalone_compile_service_http(_storage):
+    """The compile service runs as its own daemon (reference
+    arroyo-compiler-service deployable): POST /compile builds and publishes
+    the dylib; the API delegates when compiler.endpoint is configured; a
+    worker-side load of the returned artifact works."""
+    import urllib.error
+    import urllib.request
+
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.compiler import (CompileError, CompileServer,
+                                     NativeUdfSpec, compile_udf,
+                                     load_native_udf)
+    from arroyo_tpu.udf import drop_udf, lookup_udf
+
+    srv = CompileServer().start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/status") as r:
+            assert json.loads(r.read())["ok"]
+        req = urllib.request.Request(
+            f"{base}/compile",
+            data=json.dumps({"name": "scale7", "source": CPP_SCALE,
+                             "arg_dtypes": ["int64"],
+                             "return_dtype": "int64"}).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["artifact_url"].endswith(".so")
+        load_native_udf(NativeUdfSpec(
+            out["name"], tuple(out["arg_dtypes"]), out["return_dtype"],
+            out["artifact_url"]))
+        u = lookup_udf("scale7")
+        assert u is not None
+        assert list(u.fn(np.arange(4, dtype=np.int64))) == [0, 7, 14, 21]
+        drop_udf("scale7")
+
+        # bad source -> 400 with the compiler diagnostic
+        req = urllib.request.Request(
+            f"{base}/compile",
+            data=json.dumps({"name": "bad", "source": "not C++",
+                             "arg_dtypes": [], "return_dtype": "int64"}).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "g++" in json.loads(e.read())["error"]
+
+        # the API-side builder delegates through compiler.endpoint
+        cfg.update({"compiler.endpoint": base})
+        try:
+            spec = compile_udf("scale7", CPP_SCALE, ["int64"], "int64")
+            assert spec.artifact_url == out["artifact_url"]  # content-addressed
+            with pytest.raises(CompileError, match="g\\+\\+"):
+                compile_udf("bad", "not C++", [], "int64")
+        finally:
+            cfg.update({"compiler.endpoint": None})
+    finally:
+        srv.stop()
