@@ -22,6 +22,21 @@ from ..types import SourceFinishType
 from . import register_sink, register_source
 
 
+def _auth_conf(cfg: dict) -> dict:
+    """librdkafka auth/transport options passed through to the client —
+    security.protocol, sasl.*, ssl.* (a Confluent Cloud connection profile
+    is exactly bootstrap + SASL_SSL + key/secret; reference
+    connectors/src/kafka profiles). 'librdkafka.<opt>' passes any other
+    client option verbatim."""
+    out = {}
+    for k, v in cfg.items():
+        if k.startswith(("security.", "sasl.", "ssl.")):
+            out[k] = v
+        elif k.startswith("librdkafka."):
+            out[k[len("librdkafka."):]] = v
+    return out
+
+
 def _require_kafka():
     try:
         import confluent_kafka  # noqa: F401
@@ -100,6 +115,10 @@ class KafkaSource(SourceOperator):
             if saved:
                 tracker.merge(saved)
         consumer = ck.Consumer({
+            # auth first: operator-managed keys stay authoritative — a
+            # pass-through enable.auto.commit=true would silently break the
+            # state-based exactly-once contract
+            **_auth_conf(self.cfg),
             "bootstrap.servers": self.bootstrap,
             "group.id": str(self.cfg.get("group_id", f"arroyo-tpu-{ctx.task_info.job_id}")),
             "enable.auto.commit": False,
@@ -185,7 +204,7 @@ class KafkaSink(Operator):
 
     def on_start(self, ctx):
         ck = _require_kafka()
-        conf = {"bootstrap.servers": self.bootstrap}
+        conf = {"bootstrap.servers": self.bootstrap, **_auth_conf(self.cfg)}
         if self.exactly_once:
             ti = ctx.task_info
             self.txn = _TxnState(ti.job_id, ti.node_id, ti.subtask_index)

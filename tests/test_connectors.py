@@ -523,3 +523,23 @@ def test_kafka_offset_tracker_rescale():
     assert t.partitions_for(0, 1, 4) == [0, 1, 2, 3]
     t.observe(1, 75)
     assert t.resume_position(1) == 76
+
+
+def test_kafka_auth_options_pass_through():
+    """security./sasl./ssl. options (a Confluent Cloud profile) and
+    librdkafka.-prefixed options reach the client config verbatim; format
+    options do not leak in."""
+    from arroyo_tpu.connectors.kafka import _auth_conf
+
+    c = _auth_conf({
+        "bootstrap_servers": "b:9092", "format": "json", "topic": "t",
+        "security.protocol": "SASL_SSL", "sasl.mechanisms": "PLAIN",
+        "sasl.username": "API_KEY", "sasl.password": "API_SECRET",
+        "ssl.ca.location": "/etc/ssl/ca.pem",
+        "librdkafka.client.id": "arroyo-tpu",
+    })
+    assert c == {
+        "security.protocol": "SASL_SSL", "sasl.mechanisms": "PLAIN",
+        "sasl.username": "API_KEY", "sasl.password": "API_SECRET",
+        "ssl.ca.location": "/etc/ssl/ca.pem", "client.id": "arroyo-tpu",
+    }
