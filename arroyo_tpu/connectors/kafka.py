@@ -204,7 +204,9 @@ class KafkaSink(Operator):
 
     def on_start(self, ctx):
         ck = _require_kafka()
-        conf = {"bootstrap.servers": self.bootstrap, **_auth_conf(self.cfg)}
+        # auth first: operator-managed keys stay authoritative (matches the
+        # consumer's merge order)
+        conf = {**_auth_conf(self.cfg), "bootstrap.servers": self.bootstrap}
         if self.exactly_once:
             ti = ctx.task_info
             self.txn = _TxnState(ti.job_id, ti.node_id, ti.subtask_index)

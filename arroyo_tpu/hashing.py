@@ -55,7 +55,9 @@ def hash_column(col: np.ndarray) -> np.ndarray:
     arroyo_tpu.native — same splitmix64 mix, differentially tested)."""
     from . import native
 
-    if col.dtype == object:
+    if col.dtype == object or col.dtype.kind in "US":
+        # numpy unicode/bytes arrays (e.g. CASE over string literals) hash
+        # like object string columns, not like integers
         return splitmix64(_hash_string_array(col))
     if col.dtype.kind == "f":
         out = native.hash_f64(col.astype(np.float64))

@@ -495,7 +495,11 @@ def main() -> None:
     extra: dict = {}
     q7_eps = 0.0
     for name, build, parity, wend, n_ev in configs:
-        run_config(name, build, "jax", 50_000, DEV_BS)  # compile warmup
+        # warmup must see at least one FULL-size batch: a 50k-event warmup
+        # never produces a 65536-row batch, so the real run's first batch
+        # would trigger the big-shape compile mid-measurement (slow rep 0,
+        # ~20-40s per shape on TPU)
+        run_config(name, build, "jax", 3 * DEV_BS, DEV_BS)
         best_eps, best_lat = 0.0, (None, None)
         worst_p99 = None
         for r in range(reps):
