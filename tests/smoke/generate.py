@@ -458,6 +458,23 @@ def o_async_udf(ins):
     return [{"counter": -2 * r["counter"]} for r in ins["impulse"]]
 
 
+def o_most_active_driver(ins):
+    SLIDE, W = 20 * S, 60 * S
+    byw = defaultdict(lambda: defaultdict(int))
+    for r in ins["cars"]:
+        ts = input_ts(r, "timestamp")
+        sb = (ts // SLIDE) * SLIDE
+        for k in range(W // SLIDE):
+            start = sb - k * SLIDE
+            byw[start][r["driver_id"]] += 1
+    out = []
+    for w, drivers in sorted(byw.items()):
+        # ORDER BY c DESC, driver_id DESC, take row 1
+        d, c = max(drivers.items(), key=lambda kv: (kv[1], kv[0]))
+        out.append({"start": iso(w), "driver_id": d, "cnt": c, "rn": 1})
+    return out
+
+
 def o_count_distinct(ins):
     W = 20 * S
     groups = defaultdict(lambda: (set(), 0))
@@ -664,6 +681,7 @@ ORACLES = {
     "async_udf": o_async_udf,
     "memory_table": o_memory_table,
     "count_distinct": o_count_distinct,
+    "most_active_driver": o_most_active_driver,
     "offset_impulse_join": o_offset_impulse_join,
     "unnest_in_view": o_unnest_in_view,
     "json_operators": o_json_operators,

@@ -1,0 +1,35 @@
+-- Hop-window aggregate ranked by ROW_NUMBER() OVER and filtered to the
+-- top row per window (reference most_active_driver_last_hour.sql).
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE most_active_driver (
+  start TIMESTAMP,
+  driver_id BIGINT,
+  cnt BIGINT,
+  rn BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO most_active_driver
+SELECT y.w.start, y.driver_id, y.c, y.rn FROM (
+  SELECT x.w, x.driver_id, x.c, ROW_NUMBER() OVER (
+    PARTITION BY x.w ORDER BY x.c DESC, x.driver_id DESC) AS rn
+  FROM (
+    SELECT hop(interval '20 seconds', interval '60 seconds') AS w,
+           driver_id, count(*) AS c
+    FROM cars GROUP BY 1, 2
+  ) x
+) y WHERE y.rn = 1;
