@@ -9,6 +9,9 @@ struct with ``is_retract`` (arroyo-rpc/src/lib.rs:254-267); here the flat
 ``_is_retract`` boolean column plays that role end-to-end (formats serialize
 it Debezium-style at sinks).
 
+COUNT(DISTINCT) accumulates a per-value multiplicity map per key (kind
+"collect"), which inverts exactly under retractions.
+
 Input may itself be updating (downstream of an updating join): retractions
 are applied with invertible accumulators (sum/count/avg); min/max over an
 updating input would need per-key re-reduce and is rejected at plan time.
@@ -109,9 +112,16 @@ class UpdatingAggregate(Operator):
             n_agg = len(self.aggregates)
             count_i = next(
                 (i for i, k in enumerate(self.acc_kinds) if k == "count"), None)
+            import json as _json
+
             for j in range(b.num_rows):
                 h = int(hashes[j])
-                accs = [d.type(b[f"__acc_{i}"][j]) for i, d in enumerate(self.acc_dtypes)]
+                accs = [
+                    {p[0]: p[1] for p in _json.loads(b[f"__acc_{i}"][j])}
+                    if self.acc_kinds[i] == "collect"
+                    else d.type(b[f"__acc_{i}"][j])
+                    for i, d in enumerate(self.acc_dtypes)
+                ]
                 if "__count" in b:
                     count = int(b["__count"][j])
                 elif count_i is not None:
@@ -145,16 +155,22 @@ class UpdatingAggregate(Operator):
         )
         if retracts.any():
             for kind in self.acc_kinds:
-                if kind not in ("sum", "count"):
+                # collect = COUNT(DISTINCT)'s per-value multiplicity map,
+                # which inverts exactly (append +1 / retract -1 per value)
+                if kind not in ("sum", "count", "collect"):
                     raise ValueError(
                         f"updating aggregate over an updating input requires "
                         f"invertible accumulators; {kind} is not"
                     )
         # accumulate values per row, then fold per unique key
         vals = []
-        for inp, dt in zip(self.acc_inputs, self.acc_dtypes):
+        for inp, dt, kind in zip(self.acc_inputs, self.acc_dtypes, self.acc_kinds):
             if inp is None:
                 vals.append(np.ones(n, dtype=dt))
+            elif kind == "collect":
+                # raw distinct-candidate values (any hashable scalar type)
+                v = np.asarray(eval_expr(inp, batch.columns, n))
+                vals.append(v if v.dtype == object else v.astype(object))
             else:
                 vals.append(np.asarray(eval_expr(inp, batch.columns, n)).astype(dt))
         if self.device_mode:
@@ -199,6 +215,20 @@ class UpdatingAggregate(Operator):
                 app = seg[~seg_r]
                 ret = seg[seg_r]
                 cur = st.accs[i]
+                if kind == "collect":
+                    # per-value multiplicity map: distinct set = live keys
+                    m: dict = cur
+                    for v in app:
+                        v = v.item() if isinstance(v, np.generic) else v
+                        m[v] = m.get(v, 0) + 1
+                    for v in ret:
+                        v = v.item() if isinstance(v, np.generic) else v
+                        c = m.get(v, 0) - 1
+                        if c <= 0:
+                            m.pop(v, None)
+                        else:
+                            m[v] = c
+                    continue
                 if kind in ("sum", "count"):
                     cur = cur + app.sum() - ret.sum()
                 elif kind == "min":
@@ -209,6 +239,8 @@ class UpdatingAggregate(Operator):
             self.updated.add(h)
 
     def _identity(self, i: int):
+        if self.acc_kinds[i] == "collect":
+            return {}  # fresh multiplicity map per key
         from ..ops.aggregate import _identity
 
         return _identity(self.acc_kinds[i], self.acc_dtypes[i])
@@ -483,8 +515,20 @@ class UpdatingAggregate(Operator):
             "__count": np.array([st.count for _h, st in items], dtype=np.int64),
             "__has_emitted": np.array([st.emitted is not None for _h, st in items], dtype=bool),
         }
+        import json as _json
+
+        from ..batch import object_column
+
         for i, d in enumerate(self.acc_dtypes):
-            cols[f"__acc_{i}"] = np.array([st.accs[i] for _h, st in items], dtype=d)
+            if self.acc_kinds[i] == "collect":
+                # multiplicity maps persist as JSON [value, count] pairs:
+                # parquet has no stable encoding for dict-valued objects
+                cols[f"__acc_{i}"] = object_column(
+                    _json.dumps(sorted(st.accs[i].items(), key=str))
+                    for _h, st in items)
+            else:
+                cols[f"__acc_{i}"] = np.array(
+                    [st.accs[i] for _h, st in items], dtype=d)
         for i in range(n_agg):
             vals = [
                 st.emitted[i] if st.emitted is not None else 0

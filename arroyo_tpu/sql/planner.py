@@ -683,18 +683,15 @@ class Planner:
         for i, a in enumerate(uniq_aggs):
             out = f"__agg_{i}"
             if a.distinct:
-                # COUNT(DISTINCT x) rides the collect machinery (session +
-                # tumbling windows); other DISTINCT aggregates and updating
-                # inputs (retractions need per-value multiplicities) remain
-                # out of scope, like the reference's datafusion fork
+                # COUNT(DISTINCT x): collect machinery in session/tumbling
+                # windows; per-value multiplicity maps in the updating
+                # aggregate (incl. retracting inputs — beyond the reference,
+                # which rejects that case). Other DISTINCT aggregates remain
+                # out of scope, like the reference's datafusion fork.
                 if a.name != "count" or a.star or len(a.args) != 1:
                     raise PlanError(
                         "only COUNT(DISTINCT expr) is supported among "
                         "DISTINCT aggregates")
-                if rel.updating:
-                    raise PlanError(
-                        "COUNT(DISTINCT) over an updating input is "
-                        "unsupported")
                 e = compile_expr(a.args[0], rel.scope)
                 aggregates.append((out, "count_distinct", e))
                 agg_out_dtypes[out] = "int64"
@@ -797,6 +794,14 @@ class Planner:
             raise PlanError("windowed aggregates over updating inputs are unsupported")
         has_collect = any(k.startswith("udaf:") or k in ("collect", "count_distinct")
                           for _n, k, _e in aggregates)
+        if (has_collect and op == OpName.UPDATING_AGGREGATE
+                and all(k == "count_distinct" for _n, k, _e in aggregates
+                        if k.startswith("udaf:") or k in ("collect", "count_distinct"))):
+            # COUNT(DISTINCT) is invertible via per-value multiplicity maps,
+            # so the updating aggregate supports it alongside any other
+            # kinds this op takes (min/max over a RETRACTING input are
+            # rejected by the earlier updating-input check, not here)
+            has_collect = False
         if has_collect and op not in (OpName.SESSION_AGGREGATE,
                                       OpName.TUMBLING_AGGREGATE):
             # collected values are host-resident python lists; the sliding

@@ -425,12 +425,14 @@ def o_debezium_coercion(ins):
 
 def o_debezium_agg(ins):
     _envs, final = gen_aggregate_updates()
-    byp = defaultdict(lambda: [0, 0])
+    byp = defaultdict(lambda: [0, set(), 0])
     for r in final.values():
         acc = byp[f"p_{r['product_name']}"]
         acc[0] += 1
-        acc[1] += r["quantity"] + 5
-    return [{"p": p, "c": c, "q": q + 10} for p, (c, q) in sorted(byp.items())]
+        acc[1].add(r["customer_name"])
+        acc[2] += r["quantity"] + 5
+    return [{"p": p, "c": c, "d": len(d), "q": q + 10}
+            for p, (c, d, q) in sorted(byp.items())]
 
 
 def o_json_operators(ins):

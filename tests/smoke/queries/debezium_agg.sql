@@ -1,6 +1,7 @@
 -- Updating aggregate over a DEBEZIUM source: upstream u/d envelopes
--- retract into the group accumulators (reference debezium_agg.sql;
--- count(distinct) is narrowed to count(*)+sum, see planner DISTINCT gap).
+-- retract into the group accumulators, including COUNT(DISTINCT) via
+-- per-value multiplicity maps (full reference debezium_agg.sql shape —
+-- the reference itself rejects updating right sides but supports this).
 CREATE TABLE debezium_source (
   id INT PRIMARY KEY,
   customer_name TEXT,
@@ -18,6 +19,7 @@ CREATE TABLE debezium_source (
 CREATE TABLE output (
   p TEXT,
   c BIGINT,
+  d BIGINT,
   q BIGINT
 ) WITH (
   connector = 'single_file',
@@ -28,6 +30,7 @@ CREATE TABLE output (
 
 INSERT INTO output
 SELECT concat('p_', product_name) AS p, count(*) AS c,
+       count(DISTINCT customer_name) AS d,
        CAST(sum(quantity + 5) + 10 AS BIGINT) AS q
 FROM debezium_source
 GROUP BY concat('p_', product_name);

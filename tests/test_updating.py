@@ -267,3 +267,44 @@ def test_device_mode_checkpoint_restore(tmp_path, _storage):
     final = merge_updating_rows(pre + post)
     got = sorted((r["k"], r["n"], r["total"]) for r in final)
     assert got == [(0, 4, 2 * (10 + 40)), (1, 4, 2 * (20 + 50)), (2, 4, 2 * (30 + 60))]
+
+
+def test_count_distinct_retracts_and_checkpoint_roundtrip(tmp_path):
+    """COUNT(DISTINCT) over a retracting input: the per-value multiplicity
+    map inverts exactly, survives the JSON checkpoint encoding, and keeps
+    counting correctly after restore."""
+    storage = str(tmp_path / "cd-ckpt")
+    aggs = [("d", "count_distinct", Col("v")), ("cnt", "count", None)]
+    op, cfg, ctx, col = make_op(aggs=aggs, storage=storage)
+    # key a sees values 1,1,2 (distinct 2); retract one of the 1s -> still 2
+    op.process_batch(keyed_batch([0, 1, 2, 3], ["a"] * 4, [1, 1, 2, 1],
+                                 retracts=[False, False, False, True]), ctx, col)
+    op._flush(col)
+    r = [x for x in rows_of(col) if not x[IS_RETRACT_FIELD]][-1]
+    assert r["d"] == 2 and r["cnt"] == 2
+    # retract the remaining 1 -> distinct drops to 1
+    op.process_batch(keyed_batch([4], ["a"], [1], retracts=[True]), ctx, col)
+    op._flush(col)
+    r = [x for x in rows_of(col) if not x[IS_RETRACT_FIELD]][-1]
+    assert r["d"] == 1 and r["cnt"] == 1
+
+    # checkpoint with a live multi-entry map, restore, keep mutating
+    op.process_batch(keyed_batch([5, 6], ["a", "a"], [7, 8]), ctx, col)
+    op.handle_checkpoint(None, ctx, col)
+    ctx.table_manager.checkpoint(1, None)
+
+    op2 = UpdatingAggregate(cfg | {"aggregates": aggs})
+    ti = TaskInfo("j", "upd", "updating_aggregate", 0, 1)
+    tm2 = TableManager(ti, storage)
+    tm2.restore(1, op2.tables())
+    ctx2 = OperatorContext(ti, None, tm2)
+    col2 = FakeCollector()
+    op2.on_start(ctx2)
+    # retract value 7 (from before the checkpoint) and add two new values:
+    # the map restored from JSON must honor the retraction exactly
+    op2.process_batch(keyed_batch([7, 8, 9], ["a", "a", "a"], [7, 9, 10],
+                                  retracts=[True, False, False]), ctx2, col2)
+    op2._flush(col2)
+    r = [x for x in rows_of(col2) if not x[IS_RETRACT_FIELD]][-1]
+    # live values now {2, 8, 9, 10} -> distinct 4, count 4
+    assert r["d"] == 4 and r["cnt"] == 4
