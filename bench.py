@@ -400,7 +400,7 @@ def check_parity_q8(rows, event_count):
     return sum(got.values())
 
 
-def _probe_default_platform(attempts: int = 3, retry_delay_s: float = 20.0) -> str:
+def _probe_default_platform(attempts: int = 4, retry_delay_s: float = 30.0) -> str:
     """Platform kind ("tpu"/"cpu"/...) when the default jax platform (the
     TPU tunnel under the driver) initializes AND can run a computation, or
     "" when it cannot. Probed in a subprocess because a wedged tunnel HANGS
