@@ -24,17 +24,24 @@ class ApiError(RuntimeError):
 class ArroyoClient:
     """client = ArroyoClient("http://localhost:5115")"""
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 auth_token: Optional[str] = None):
+        from ..config import config
+
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # explicit token, else the cluster config's (api.auth-token)
+        self.auth_token = auth_token or config().get("api.auth-token")
 
     # ------------------------------------------------------------- plumbing
 
     def _req(self, method: str, path: str, body: Optional[dict] = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
         req = urllib.request.Request(
-            self.base_url + path, data=data, method=method,
-            headers={"Content-Type": "application/json"},
+            self.base_url + path, data=data, method=method, headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:

@@ -32,8 +32,20 @@ from ..controller.db import Database
 
 
 class ApiServer:
+    """Trust model: by default the API trusts its network — anyone who can
+    reach the port can register UDFs (which execute user code on the
+    cluster, same exposure as the reference's UDF surface) and manage
+    pipelines. Deployments beyond localhost should set ``api.auth-token``
+    (ARROYO_TPU__API__AUTH_TOKEN): every mutating request (non-GET) must
+    then carry ``Authorization: Bearer <token>``; reads stay open for
+    dashboards. The node daemon and typed client pick the token up from
+    the same config."""
+
     def __init__(self, db: Database, port: int = 0, host: str = "127.0.0.1"):
+        from ..config import config
+
         self.db = db
+        self.auth_token = config().get("api.auth-token")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -111,6 +123,13 @@ class ApiServer:
 
     def _route(self, h, method: str) -> None:
         path = h.path.split("?", 1)[0]
+        if self.auth_token and method != "GET":
+            # shared-token gate on every mutating endpoint (ADVICE r4: the
+            # UDF surface is remote code execution by design; see class
+            # docstring for the trust model)
+            if h.headers.get("Authorization") != f"Bearer {self.auth_token}":
+                h._json(401, {"error": "missing or invalid bearer token"})
+                return
         for m, pat, name in self._ROUTES:
             if m != method:
                 continue

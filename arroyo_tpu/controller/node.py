@@ -23,9 +23,15 @@ from typing import Optional
 
 
 def _post(url: str, body: dict, timeout: float = 10.0) -> dict:
+    from ..config import config
+
+    headers = {"Content-Type": "application/json"}
+    token = config().get("api.auth-token")
+    if token:
+        # the cluster API gates mutating requests when a token is set
+        headers["Authorization"] = f"Bearer {token}"
     req = urllib.request.Request(
-        url, data=json.dumps(body).encode(), method="POST",
-        headers={"Content-Type": "application/json"},
+        url, data=json.dumps(body).encode(), method="POST", headers=headers,
     )
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read())

@@ -281,3 +281,52 @@ INSERT INTO snk SELECT x FROM src;
         cfg.update({"checkpoint.interval-ms": 10_000})
         ctl.stop()
         api.stop()
+
+
+def test_api_auth_token_gates_mutations(_storage):
+    """With api.auth-token set, mutating requests need the bearer token
+    (401 otherwise); reads stay open; the typed client and node-daemon
+    POST helper pick the token up from config (ADVICE r4 trust model)."""
+    import urllib.error
+    import urllib.request
+
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.api import ApiServer
+    from arroyo_tpu.api.client import ArroyoClient
+    from arroyo_tpu.controller import Database
+    from arroyo_tpu.controller.node import _post
+
+    cfg.update({"api.auth-token": "s3cret"})
+    try:
+        api = ApiServer(Database()).start()
+        base = f"http://127.0.0.1:{api.port}"
+        try:
+            # reads open
+            with urllib.request.urlopen(f"{base}/api/v1/jobs") as r:
+                assert r.status == 200
+            # bare mutation -> 401
+            req = urllib.request.Request(
+                f"{base}/api/v1/pipelines/validate",
+                data=json.dumps({"query": "SELECT 1"}).encode(),
+                method="POST", headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError("expected 401")
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+            # wrong token -> 401
+            req.add_header("Authorization", "Bearer nope")
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError("expected 401")
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+            # typed client + node-daemon _post carry the config token
+            c = ArroyoClient(base)
+            assert not c.validate_query("SELEC nope")["valid"]
+            assert _post(f"{base}/api/v1/nodes/register",
+                         {"node_id": "n1", "addr": "http://x", "slots": 1})
+        finally:
+            api.stop()
+    finally:
+        cfg.update({"api.auth-token": None})
