@@ -1,7 +1,7 @@
 """arroyo-tpu: a TPU-native distributed stream processing framework.
 
 SQL pipelines over unbounded streams with event-time watermarks, windowed
-aggregates/joins lowered to JAX/XLA/Pallas, exactly-once Parquet
+aggregates/joins lowered to JAX/XLA, exactly-once Parquet
 checkpointing, and keyed exchange over TPU ICI collectives. Built new against
 the capabilities of the reference engine surveyed in SURVEY.md.
 """

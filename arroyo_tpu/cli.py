@@ -197,10 +197,16 @@ def _cmd_worker(args) -> int:
             emit({"event": "finished"})
             return 0
         if time.monotonic() - last_hb > 1.0:
-            emit({"event": "heartbeat"})
-            from arroyo_tpu.metrics import registry as _mreg
+            # chaos hook: dropping heartbeats (worker.heartbeat:drop) models
+            # a hung-but-not-dead worker; the controller's heartbeat-timeout
+            # detection must declare it lost and recover
+            from arroyo_tpu.faults import fault_point
 
-            emit({"event": "metrics", "data": _mreg.job_metrics(args.job_id)})
+            if (fault_point("worker.heartbeat") or (None,))[0] != "drop":
+                emit({"event": "heartbeat"})
+                from arroyo_tpu.metrics import registry as _mreg
+
+                emit({"event": "metrics", "data": _mreg.job_metrics(args.job_id)})
             last_hb = time.monotonic()
         time.sleep(0.05)
 

@@ -11,8 +11,15 @@ from __future__ import annotations
 import copy
 import os
 import threading
-import tomllib
 from typing import Any
+
+try:  # tomllib is stdlib from 3.11; tomli is the same parser for 3.10
+    import tomllib
+except ModuleNotFoundError:  # pragma: no cover - depends on interpreter
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ModuleNotFoundError:
+        tomllib = None  # type: ignore[assignment]
 
 _DEFAULTS: dict[str, Any] = {
     "pipeline": {
@@ -41,6 +48,22 @@ _DEFAULTS: dict[str, Any] = {
     "checkpoint": {
         "storage-url": "/tmp/arroyo-tpu/checkpoints",
         "interval-ms": 10_000,
+    },
+    "storage": {
+        # shared resilience layer (utils/retry.py) for object-store ops
+        "retry": {
+            "max-attempts": 4,
+            "base-delay-ms": 50,
+            "max-delay-ms": 2000,
+            "multiplier": 2.0,
+            "jitter": 0.5,
+        },
+    },
+    "faults": {
+        # deterministic fault injection (arroyo_tpu.faults); empty = off.
+        # e.g. "storage.put:fail_once@epoch=2,worker:crash@barrier=3"
+        "plan": "",
+        "seed": 0,
     },
     "controller": {
         "scheduler": "embedded",
@@ -93,15 +116,21 @@ def _merge(base: dict, over: dict) -> dict:
 
 def _load() -> Config:
     data = copy.deepcopy(_DEFAULTS)
-    for path in ("/etc/arroyo-tpu/config.toml",
-                 os.path.expanduser("~/.config/arroyo-tpu/config.toml"),
-                 "arroyo-tpu.toml"):
-        if os.path.exists(path):
-            with open(path, "rb") as f:
-                data = _merge(data, tomllib.load(f))
+    paths = ["/etc/arroyo-tpu/config.toml",
+             os.path.expanduser("~/.config/arroyo-tpu/config.toml"),
+             "arroyo-tpu.toml"]
     env_file = os.environ.get("ARROYO_TPU_CONFIG")
-    if env_file and os.path.exists(env_file):
-        with open(env_file, "rb") as f:
+    if env_file:
+        paths.append(env_file)
+    for path in paths:
+        if not os.path.exists(path):
+            continue
+        if tomllib is None:
+            raise RuntimeError(
+                f"config file {path} exists but no TOML parser is available "
+                f"(need Python >= 3.11 or the tomli package)"
+            )
+        with open(path, "rb") as f:
             data = _merge(data, tomllib.load(f))
     # ARROYO_TPU__WORKER__QUEUE_SIZE=1024 -> worker.queue-size
     for key, val in os.environ.items():

@@ -288,7 +288,11 @@ class DeltaSink(FileSystemSink):
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        drop = {TIMESTAMP_FIELD, KEY_FIELD}
+        # Delta protocol: partition column values live in the log's
+        # partitionValues and the hive-style directory name, never in the
+        # part file itself (readers materialize them; a copy in the file
+        # conflicts with the inferred partition field type)
+        drop = {TIMESTAMP_FIELD, KEY_FIELD, *self.partition_fields}
         clean = [{k: v for k, v in r.items() if k not in drop} for r in rows]
         ts_fields = {f.name for f in self.schema.fields if f.dtype == "timestamp"}
         names = list(clean[0].keys()) if clean else []

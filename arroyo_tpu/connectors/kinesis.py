@@ -11,7 +11,15 @@ Options: stream_name, aws_region (default us-east-1), endpoint (override
 for tests/localstack), aws_access_key_id / aws_secret_access_key (or the
 standard env vars), 'source.offset' = earliest|latest (shard TRIM_HORIZON
 vs LATEST). The source checkpoints the last-read sequence number per shard
-and resumes AFTER_SEQUENCE_NUMBER; shards split across subtasks by index.
+and resumes AFTER_SEQUENCE_NUMBER.
+
+Shard -> subtask assignment is a STABLE hash of the shard id
+(crc32(shard_id) % parallelism, identical on every worker), and every
+subtask re-lists shards periodically regardless of its open-shard state:
+after a reshard, child shards are picked up by whichever subtask owns them
+and an existing open shard can never migrate or double-assign when the
+shard list changes (index-mod assignment shifted every surviving shard on
+each reshard, silently dropping children and transiently double-reading).
 """
 
 from __future__ import annotations
@@ -25,12 +33,21 @@ import os
 import time
 import urllib.error
 import urllib.request
+import zlib
 from typing import Optional
 
 from ..batch import Schema
+from ..faults import InjectedFault, fault_point
 from ..operators.base import Operator, SourceOperator, TableSpec
 from ..types import SourceFinishType
+from ..utils.retry import Backoff, RetryPolicy
 from . import register_sink, register_source
+
+
+def shard_owner(shard_id: str, parallelism: int) -> int:
+    """Stable shard->subtask assignment: identical across processes and
+    restarts (python's hash() is salted per process, so it cannot be used)."""
+    return zlib.crc32(shard_id.encode()) % max(parallelism, 1)
 
 
 class KinesisError(RuntimeError):
@@ -128,9 +145,13 @@ class KinesisClient:
                     max_retries: int = 8) -> None:
         """Retries ONLY the failed subset on partial failure (per-record
         throttling is routine under load; re-sending the whole batch would
-        duplicate the records that already landed)."""
+        duplicate the records that already landed). Delays come from the
+        shared backoff layer so chaos runs and production behave alike."""
         pending = records
-        for attempt in range(max_retries + 1):
+        backoff = Backoff(RetryPolicy(max_attempts=max_retries,
+                                      base_delay_s=0.1, max_delay_s=2.0,
+                                      jitter=0.2))
+        while True:
             resp = self.call("PutRecords", {
                 "StreamName": stream,
                 "Records": [
@@ -145,10 +166,11 @@ class KinesisClient:
                        if res.get("ErrorCode")]
             if not pending:
                 return
-            time.sleep(min(0.1 * 2 ** attempt, 2.0))
-        raise KinesisError(
-            f"PutRecords: {len(pending)} records still failing after "
-            f"{max_retries} retries")
+            if backoff.exhausted():
+                raise KinesisError(
+                    f"PutRecords: {len(pending)} records still failing after "
+                    f"{max_retries} retries")
+            time.sleep(backoff.next_delay())
 
 
 def _client_from(cfg: dict) -> KinesisClient:
@@ -191,13 +213,16 @@ class KinesisSource(SourceOperator):
         kind = "TRIM_HORIZON" if self.offset == "earliest" else "LATEST"
         iters: dict[str, Optional[str]] = {}
         mine: list[str] = []
+        first_list = True
 
         def assign_shards() -> None:
-            """(Re)list shards and open iterators for newly-seen ones —
-            called at start and after a reshard closes this subtask's
-            shards (parents close, children appear)."""
-            shards = sorted(client.list_shards(self.stream))
-            mine[:] = [s for i, s in enumerate(shards) if i % par == sub]
+            """(Re)list shards and open iterators for newly-owned ones.
+            Ownership is the stable crc32 hash, so re-listing NEVER moves a
+            shard between subtasks — child shards appear under their owner
+            and open shards cannot double-assign during a reshard."""
+            nonlocal first_list
+            shards = client.list_shards(self.stream)
+            mine[:] = sorted(s for s in shards if shard_owner(s, par) == sub)
             for s in mine:
                 if s in iters:
                     continue
@@ -205,7 +230,13 @@ class KinesisSource(SourceOperator):
                     iters[s] = client.shard_iterator(
                         self.stream, s, "AFTER_SEQUENCE_NUMBER", seqs[s])
                 else:
-                    iters[s] = client.shard_iterator(self.stream, s, kind)
+                    # the configured LATEST/TRIM_HORIZON offset applies only
+                    # to the startup listing; a shard appearing mid-run is a
+                    # reshard child whose records must be read from the
+                    # start or everything written before discovery is lost
+                    iters[s] = client.shard_iterator(
+                        self.stream, s, kind if first_list else "TRIM_HORIZON")
+            first_list = False
 
         assign_shards()
         de = make_deserializer(self.cfg, self.schema)
@@ -218,8 +249,13 @@ class KinesisSource(SourceOperator):
         idle_sleep = float(self.cfg.get("poll_interval_s", 0.2))
         # AWS caps GetRecords at 5 calls/sec/shard: pace each shard
         min_gap = float(self.cfg.get("shard_poll_gap_s", 0.2))
+        # every subtask re-lists periodically even while its shards are
+        # healthy: a reshard's children otherwise sit unread forever on any
+        # subtask that still has open long-lived shards
+        reshard_interval = float(self.cfg.get("reshard_interval_s", 5.0))
         last_poll: dict[str, float] = {}
-        backoff = 0.0
+        backoff = Backoff(RetryPolicy(max_attempts=1 << 30, base_delay_s=0.2,
+                                      max_delay_s=5.0, jitter=0.25))
         reshard_check = time.monotonic()
         while True:
             msg = sctx.poll_control()
@@ -236,21 +272,23 @@ class KinesisSource(SourceOperator):
             for s in list(mine):
                 it = iters.get(s)
                 if it is None:
-                    continue  # shard closed (reshard); children picked up below
+                    continue  # shard closed (reshard); children re-listed below
                 now = time.monotonic()
                 if now - last_poll.get(s, 0.0) < min_gap:
                     continue
                 last_poll[s] = now
                 try:
+                    fault_point("connector.poll", connector="kinesis", key=s)
                     resp = client.get_records(it)
-                    backoff = 0.0
-                except KinesisError:
-                    # throttling / transient failure: back off and refresh
-                    # the iterator (a >5min outage expires it — retrying the
-                    # stale one would wedge the shard forever); never kill
-                    # the task over a routine 400
-                    backoff = min(max(backoff * 2, 0.2), 5.0)
-                    time.sleep(backoff)
+                    backoff.reset()
+                except (KinesisError, InjectedFault) as e:
+                    if isinstance(e, InjectedFault) and not e.transient:
+                        raise  # InjectedCrash: worker-fatal, the task must die
+                    # throttling / transient failure: back off (shared layer)
+                    # and refresh the iterator (a >5min outage expires it —
+                    # retrying the stale one would wedge the shard forever);
+                    # never kill the task over a routine 400
+                    time.sleep(backoff.next_delay())
                     try:
                         if s in seqs:
                             iters[s] = client.shard_iterator(
@@ -271,10 +309,13 @@ class KinesisSource(SourceOperator):
                     if de.should_flush():
                         flush()
             all_closed = bool(mine) and all(iters.get(s) is None for s in mine)
-            if (all_closed or not mine) and time.monotonic() - reshard_check > 2.0:
-                # a reshard closes parents and creates children; a subtask
-                # with no shards (parallelism > shard count) may gain some
-                reshard_check = time.monotonic()
+            now = time.monotonic()
+            # a subtask with nothing open re-lists eagerly (2s); a healthy
+            # one still sweeps every reshard_interval for child shards
+            if (now - reshard_check
+                    > (min(2.0, reshard_interval) if (all_closed or not mine)
+                       else reshard_interval)):
+                reshard_check = now
                 try:
                     assign_shards()
                 except KinesisError:

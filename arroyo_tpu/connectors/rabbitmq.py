@@ -9,8 +9,11 @@ the MQTT/NATS connectors.
 Subset implemented: PLAIN auth handshake (Connection Start/Tune/Open),
 channel open, Queue.Declare, Basic.Publish (content header + single body
 frame per message), Basic.Consume/Deliver with per-message Basic.Ack, and
-heartbeat frames both ways. Delivery is at-least-once: messages ack after
-they reach the deserializer; unacked messages redeliver on reconnect.
+heartbeat frames both ways. Delivery is at-least-once: delivery tags are
+held keyed by checkpoint epoch and acked only when the engine's COMMIT
+control message confirms that epoch's checkpoint is durable (the same
+two-phase flow the exactly-once Kafka sink uses) — a crash at any point
+before the commit leaves the tags unacked, so the broker redelivers.
 
 Options: host, port (5672), username/password (guest/guest), vhost (/),
 queue (source), exchange + routing_key (sink; default exchange when empty).
@@ -247,34 +250,87 @@ class RabbitmqSource(SourceOperator):
         self.schema: Schema = cfg["schema"]
         self.queue = str(cfg["queue"])
 
+    def is_committing(self) -> bool:
+        # acks are phase 2 of the checkpoint: the engine must send this
+        # source a commit message once the epoch's metadata is durable
+        return True
+
     def run(self, sctx, collector) -> SourceFinishType:
-        """Checkpoint-deferred acks: tags collect as messages reach the
-        deserializer and ack in one batch when the checkpoint barrier takes
-        them — a crash before the barrier leaves them unacked, so the
-        broker redelivers (at-least-once; duplicates possible)."""
+        """Commit-deferred acks: tags collect as messages reach the
+        deserializer; a checkpoint barrier moves the batch under its epoch,
+        and the batch acks only when the engine's post-checkpoint COMMIT for
+        that epoch arrives. A crash mid-checkpoint (barrier seen, metadata
+        not yet durable) therefore leaves the tags unacked and the broker
+        redelivers after restore — at-least-once holds through the exact
+        window where barrier-time acking used to lose data."""
         import socket as _socket
         import time as _time
 
+        from ..faults import InjectedFault, fault_point
         from ..formats.registry import make_deserializer
+        from ..utils.retry import Backoff, RetryPolicy, retry_call
 
         client = _client_from(self.cfg)
         client.queue_declare(self.queue)
         client.consume(self.queue)
         client.sock.settimeout(0.2)
         de = make_deserializer(self.cfg, self.schema)
-        pending_tags: list[int] = []
+        pending_tags: list[int] = []        # delivered since the last barrier
+        tags_by_epoch: dict[int, list[int]] = {}  # barrier-taken, ack on commit
         ka_interval = client.heartbeat / 2 if client.heartbeat else 20.0
         last_sent = _time.monotonic()
+        poll_backoff = Backoff(RetryPolicy(max_attempts=1 << 30,
+                                           base_delay_s=0.05, max_delay_s=1.0))
 
         def flush():
             b = de.flush()
             if b is not None:
                 collector.collect(b)
 
-        def ack_pending():
-            for tag in pending_tags:
-                client.ack(tag)
-            pending_tags.clear()
+        def ack_through(epoch: int) -> None:
+            """Ack every epoch <= the committed one (a straggling commit for
+            an older epoch must not strand its tags forever)."""
+            for ep in sorted(e for e in tags_by_epoch if e <= epoch):
+                tags = tags_by_epoch.pop(ep)
+
+                def _ack_remaining(_tags=tags, _ep=ep):
+                    # tags pop as they ack, so a retry after a mid-batch
+                    # failure never double-acks (AMQP closes the channel on
+                    # an unknown delivery tag)
+                    fault_point("connector.commit", connector="rabbitmq", epoch=_ep)
+                    while _tags:
+                        client.ack(_tags[0])
+                        _tags.pop(0)
+
+                try:
+                    retry_call(_ack_remaining, policy=RetryPolicy(max_attempts=4),
+                               description=f"rabbitmq ack epoch {ep}")
+                except Exception as e:  # noqa: BLE001 - transient exhaustion
+                    # keep the leftovers staged: a later commit retries them,
+                    # and a crash redelivers them (redelivery > data loss)
+                    if tags:
+                        tags_by_epoch[ep] = tags
+                    if isinstance(e, InjectedFault) and not e.transient:
+                        raise  # InjectedCrash: worker-fatal, the task must die
+
+        def await_commit(epoch: int, deadline_s: float = 30.0) -> None:
+            """Checkpoint-then-stop: wait for the stopping epoch's commit so
+            its tags ack before the connection closes (mirrors the committing
+            operator wait in the task run loop)."""
+            deadline = _time.monotonic() + deadline_s
+            while _time.monotonic() < deadline:
+                msg = sctx.poll_control()
+                if msg is None:
+                    _time.sleep(0.05)
+                    continue
+                if msg.kind == "stop":
+                    # engine abort: the commit will never come — leave the
+                    # tags unacked (broker redelivers) and shut down now
+                    return
+                if msg.kind == "commit" and msg.epoch is not None:
+                    ack_through(msg.epoch)
+                    if msg.epoch >= epoch:
+                        return
 
         while True:
             if client.heartbeat and _time.monotonic() - last_sent > ka_interval:
@@ -288,18 +344,31 @@ class RabbitmqSource(SourceOperator):
             if msg is not None:
                 if msg.kind == "checkpoint":
                     flush()
-                    # everything the barrier covers is now durable upstream
-                    # of the broker: safe to ack
-                    ack_pending()
+                    # the barrier only STAGES the tags under this epoch; the
+                    # broker sees acks when the commit confirms durability
+                    if pending_tags:
+                        tags_by_epoch.setdefault(
+                            msg.barrier.epoch, []).extend(pending_tags)
+                        pending_tags = []
                     sctx.start_checkpoint(msg.barrier)
                     if msg.barrier.then_stop:
+                        await_commit(msg.barrier.epoch)
                         client.close()
                         return SourceFinishType.FINAL
+                elif msg.kind == "commit" and msg.epoch is not None:
+                    ack_through(msg.epoch)
                 elif msg.kind == "stop":
                     client.close()
                     return SourceFinishType.IMMEDIATE
             try:
+                fault_point("connector.poll", connector="rabbitmq", key=self.queue)
                 got = client.next_delivery()
+                poll_backoff.reset()
+            except InjectedFault as e:
+                if not e.transient:
+                    raise  # InjectedCrash: worker-fatal, the task must die
+                _time.sleep(poll_backoff.next_delay())  # transient: retry
+                continue
             except (TimeoutError, _socket.timeout):
                 if de.should_flush():
                     flush()

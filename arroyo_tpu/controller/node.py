@@ -120,9 +120,13 @@ class NodeServer:
         h._json(404, {"error": f"no route {method} {path}"})
 
     def _start_worker(self, h) -> None:
+        from ..faults import fault_point
         from .scheduler import ProcessWorkerHandle
 
         body = h._body()
+        # chaos hook: a failed admission surfaces as HTTP 500 and exercises
+        # the scheduler's placement retry/fallback path
+        fault_point("node.start_worker", job=str(body.get("job_id", "")))
         wid = f"worker_{uuid.uuid4().hex[:12]}"
         with self._lock:
             # a None value is another request's under-lock reservation whose

@@ -71,6 +71,12 @@ class Engine:
         on workers (reference compute_assignments, states/scheduling.rs:56);
         None runs everything in this engine. Remote edges ride ``network``
         (engine.network.NetworkManager over the C++ data plane)."""
+        # chaos: a configured fault plan (faults.plan / ARROYO_TPU__FAULTS__
+        # PLAN) activates with fresh counters per engine incarnation, so a
+        # restarted worker replays its faults deterministically
+        from ..faults import install_from_config
+
+        install_from_config()
         if config().get("pipeline.chaining.enabled"):
             from ..optimizer import chain_graph
 
@@ -298,6 +304,14 @@ class Engine:
                 # two-phase commit: metadata is durable, tell committing
                 # sinks to finalize (reference send_commit_messages,
                 # job_controller/mod.rs:838)
+                # KNOWN LIMIT (multi-worker embedded mode only): _n_tasks
+                # counts LOCAL tasks, so with an assignment this fires when
+                # this worker's subtasks finish the epoch — remote workers
+                # may still be snapshotting. Distributed runs need the
+                # controller to own epoch completion (cross-worker
+                # CheckpointState); until then committing sources/sinks in
+                # assignment mode can finalize against a not-yet-global
+                # epoch.
                 for key, task in self.tasks.items():
                     if key in self._finished_tasks:
                         continue
@@ -385,6 +399,17 @@ class Engine:
                     f"{len(alive)} tasks still running after join timeout"
                 )
             alive[0].join(0.2)
+        # every task thread has exited, but the final task_finished /
+        # task_failed responses may still be in flight on the resp queue —
+        # wait for the accounting to catch up, or a failure posted just
+        # before a thread died would be silently swallowed and a crashed
+        # pipeline would report success
+        catchup = time.monotonic() + 5.0
+        with self._lock:
+            while (self._n_tasks
+                   and len(self._finished_tasks) + len(self._failed) < self._n_tasks
+                   and time.monotonic() < catchup):
+                self._cond.wait(timeout=0.1)
         if self._failed:
             raise RuntimeError(f"pipeline task failed:\n{self._failed[0].error}")
 

@@ -17,6 +17,7 @@ import threading
 from typing import Optional
 
 from ..batch import Batch
+from ..faults import InjectedFault, fault_point
 from ..native import MSG_DATA, MSG_SIGNAL, DataPlaneConn, DataPlaneListener
 from ..native.wire import decode_batch, decode_signal, encode_batch, encode_signal
 from ..types import Signal
@@ -35,13 +36,23 @@ class RemoteDest:
     def put(self, input_index: int, item) -> None:
         # input_index is re-derived on the receiving side from the quad;
         # it is carried implicitly (registration maps quad -> flat index)
+        # chaos hook: partition raises ConnectionError here (the sending
+        # task dies exactly as if the peer vanished); drop/dup/delay model
+        # the failure modes a correct protocol must NOT tolerate silently
+        verdict = fault_point("network.send", key=f"{self.quad}",
+                              worker=self.worker)
+        if verdict is not None and verdict[0] == "drop":
+            return
         conn = self.manager.conn_to(self.worker)
         if isinstance(item, Batch):
-            conn.send(self.quad, MSG_DATA, encode_batch(item))
+            payload, mtype = encode_batch(item), MSG_DATA
         elif isinstance(item, Signal):
-            conn.send(self.quad, MSG_SIGNAL, encode_signal(item))
+            payload, mtype = encode_signal(item), MSG_SIGNAL
         else:
             raise TypeError(f"cannot ship {type(item)} over the data plane")
+        conn.send(self.quad, mtype, payload)
+        if verdict is not None and verdict[0] == "dup":
+            conn.send(self.quad, mtype, payload)
 
 
 class NetworkManager:
@@ -105,6 +116,12 @@ class NetworkManager:
             if got is None:
                 return
             quad, mtype, payload = got
+            try:
+                verdict = fault_point("network.recv", key=f"{quad}", kind=mtype)
+            except (InjectedFault, ConnectionError):
+                return  # injected receive-side partition: reader dies
+            if verdict is not None and verdict[0] == "drop":
+                continue
             target = self._receivers.get(quad)
             if target is None:
                 continue  # late frame for a finished task

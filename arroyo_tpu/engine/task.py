@@ -20,6 +20,7 @@ from collections import deque
 from typing import Optional, Union
 
 from ..batch import Batch
+from ..faults import fault_point
 from ..operators.base import Operator, OperatorContext, SourceOperator
 from ..operators.collector import Collector
 from ..types import (
@@ -167,6 +168,12 @@ class Task:
             barrier.epoch, self.task_info.node_id, self.task_info.subtask_index,
             int(time.time() * 1e6), "started_checkpointing"))
         meta = self.ctx.table_manager.checkpoint(barrier.epoch, self.ctx.watermark())
+        # chaos hook: a crash HERE is the worst case — state files for this
+        # epoch are on disk but the epoch never completes (no job metadata),
+        # so recovery must ignore them and restore the previous epoch
+        fault_point("worker", barrier=barrier.epoch,
+                    node=self.task_info.node_id,
+                    subtask=self.task_info.subtask_index)
         self.collector.broadcast(Signal.barrier_of(barrier))
         self._resp("checkpoint_completed", epoch=barrier.epoch, subtask_metadata=meta)
 
@@ -204,6 +211,11 @@ class Task:
                 int(time.time() * 1e6), "started_checkpointing"))
             op.handle_checkpoint(b, self.ctx, self.collector)
             meta = self.ctx.table_manager.checkpoint(b.epoch, self.ctx.watermark())
+            # chaos hook: mirror of run_source_checkpoint — crash with this
+            # subtask's epoch state written but the epoch incomplete
+            fault_point("worker", barrier=b.epoch,
+                        node=self.task_info.node_id,
+                        subtask=self.task_info.subtask_index)
             self.collector.broadcast(Signal.barrier_of(b))
             self._resp("checkpoint_completed", epoch=b.epoch, subtask_metadata=meta)
 

@@ -8,7 +8,7 @@ AST that evaluates two ways:
     watermark generators, key calculation).
   - ``eval_jnp(cols)``    — jax.numpy under ``jit``; used inside the device
     window/aggregate step functions so projections and filters fuse with the
-    Pallas/XLA reduction kernels (XLA op fusion plays the role of the
+    XLA reduction kernels (XLA op fusion plays the role of the
     reference's operator chaining for expressions).
 
 The SQL planner (arroyo_tpu.sql) compiles parsed SQL scalar expressions into

@@ -1,0 +1,128 @@
+"""Deterministic fault injection threaded through the engine.
+
+The exactly-once claims in this repo (Chandy-Lamport barrier checkpoints,
+two-phase-commit sinks, crash-consistent compaction) are only claims until a
+failure actually happens mid-protocol. This subsystem makes failures happen
+on purpose, deterministically, at the seams where real deployments lose
+data: storage puts/gets, the TCP data plane, queue backpressure, connector
+poll/commit, and worker crashes mid-checkpoint. The chaos suite
+(tests/test_faults.py plus the ``chaos``-marked axis of tests/test_smoke.py)
+reruns golden-output pipelines under these faults and asserts byte-exact
+recovery — exactly-once proved, not claimed.
+
+Usage::
+
+    faults.install("worker:crash@barrier=2&step=1", seed=7)   # direct
+    # or config-driven (env: ARROYO_TPU__FAULTS__PLAN / __FAULTS__SEED):
+    config.update({"faults.plan": "storage.put:fail_once@epoch=2"})
+
+Call sites are no-ops (one global read) when no plan is installed, so the
+hooks stay in production builds. Plan syntax lives in
+``arroyo_tpu.faults.plan`` and the README's "Fault injection" section.
+
+Instrumented sites:
+
+    storage.put / storage.get / storage.delete / storage.list
+                        object-store ops (ctx: key=path); retried by the
+                        shared retry layer, so transient actions recover
+                        without a job restart
+    storage.multipart   per-part S3 multipart upload (ctx: key, part)
+    network.send        data-plane frame send (ctx: key="e,s->n,d" quad,
+                        worker); drop/dup/delay/partition
+    network.recv        data-plane frame receive (ctx: key, kind)
+    queue.put           task inbox enqueue (ctx: input); delay models
+                        backpressure stalls
+    connector.poll      broker source poll (ctx: connector, key)
+    connector.commit    broker ack/commit (ctx: connector, epoch)
+    worker              barrier-time crash point in the task run loop
+                        (ctx: barrier, node, subtask) — fires AFTER the
+                        subtask's state files are written and BEFORE its
+                        checkpoint-completed response, the worst spot
+    worker.heartbeat    worker->controller heartbeat emission (drop to
+                        starve the controller's liveness check)
+    node.start_worker   node daemon worker admission (ctx: job)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from .plan import (  # noqa: F401 - public API
+    FaultInjector,
+    FaultSpec,
+    InjectedCrash,
+    InjectedFault,
+    InjectedPartition,
+    PlanSyntaxError,
+    parse_plan,
+)
+
+_log = logging.getLogger("arroyo_tpu.faults")
+
+_lock = threading.Lock()
+_active: Optional[FaultInjector] = None
+_from_config = False
+
+SITES = (
+    "storage.put", "storage.get", "storage.delete", "storage.list",
+    "storage.multipart", "network.send", "network.recv", "queue.put",
+    "connector.poll", "connector.commit", "worker", "worker.heartbeat",
+    "node.start_worker",
+)
+
+
+def install(plan: str, seed: int = 0, _config_origin: bool = False) -> FaultInjector:
+    """Parse and activate ``plan``; returns the injector. The plan and seed
+    are logged so any chaos failure is replayable."""
+    global _active, _from_config
+    inj = FaultInjector(plan, seed=seed)
+    with _lock:
+        _active = inj
+        _from_config = _config_origin
+    _log.info("fault plan installed: %r (seed=%d)", plan, seed)
+    return inj
+
+
+def clear() -> None:
+    global _active, _from_config
+    with _lock:
+        _active = None
+        _from_config = False
+
+
+def active() -> Optional[FaultInjector]:
+    return _active
+
+
+def install_from_config() -> Optional[FaultInjector]:
+    """Sync the injector with ``faults.plan`` / ``faults.seed`` config.
+
+    Called at Engine construction so worker subprocesses pick plans up from
+    the environment. A non-empty configured plan (re)installs with FRESH
+    counters — each worker incarnation replays its faults, which is what
+    restart-crash loops need. An empty config only clears a plan that came
+    from config; plans installed directly by tests are left alone.
+    """
+    from ..config import config
+
+    plan = config().get("faults.plan") or ""
+    if plan:
+        seed = int(config().get("faults.seed") or 0)
+        return install(str(plan), seed=seed, _config_origin=True)
+    with _lock:
+        was_config = _from_config
+    if was_config:
+        clear()
+    return None
+
+
+def fault_point(site: str, **ctx) -> Optional[tuple]:
+    """The hook embedded at instrumented call sites. Fast no-op when no
+    plan is active. May raise InjectedFault/InjectedCrash/InjectedPartition
+    or return a ("drop"|"dup"|"delay"|"hang", arg) verdict."""
+    inj = _active
+    if inj is None:
+        return None
+    return inj.hit(site, **ctx)

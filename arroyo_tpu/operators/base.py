@@ -132,6 +132,13 @@ class SourceOperator:
     def on_start(self, ctx: OperatorContext) -> None:
         pass
 
+    def is_committing(self) -> bool:
+        """True if this source defers side effects (e.g. broker acks) to the
+        engine's post-checkpoint commit message; the engine then delivers
+        ``ControlMessage(kind="commit", epoch=...)`` via poll_control once
+        the epoch's job-level metadata is durable."""
+        return False
+
     def run(self, ctx: OperatorContext, collector: "Collector") -> SourceFinishType:
         raise NotImplementedError
 

@@ -1,4 +1,4 @@
-"""Device (JAX/XLA/Pallas) compute runtime.
+"""Device (JAX/XLA) compute runtime.
 
 64-bit support is required: routing keys are 64-bit hashes and integer SUM
 accumulators need i64 range. TPUs emulate i64 with i32 limb pairs under XLA;

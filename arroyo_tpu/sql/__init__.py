@@ -4,7 +4,7 @@ TPU-native replacement for the reference's planner crate
 (crates/arroyo-planner — parse_and_get_program, lib.rs:534): instead of a
 forked DataFusion producing serialized physical plans, a self-contained
 lexer/parser/planner compiles SQL directly to the Graph IR whose operator
-bodies are the jax/Pallas window runtime (arroyo_tpu.ops) and the expression
+bodies are the jax window runtime (arroyo_tpu.ops) and the expression
 AST (arroyo_tpu.expr).
 
 Scope mirrors what the reference's smoke-test suite exercises: connector DDL

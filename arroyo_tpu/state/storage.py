@@ -22,7 +22,11 @@ from __future__ import annotations
 import logging
 import os
 import shutil
-from typing import Optional
+import time
+from typing import Callable, Optional
+
+from ..faults import fault_point
+from ..utils.retry import CircuitBreaker, RetryPolicy, default_transient, retry_call
 
 _log = logging.getLogger("arroyo_tpu.storage")
 
@@ -30,6 +34,33 @@ _s3_client = None
 _gcs_client = None
 
 MULTIPART_DEFAULT = 8 * 1024 * 1024
+
+# One breaker across all object-store ops: when the store is hard-down,
+# checkpoint attempts fail fast instead of each burning a full retry
+# schedule (the controller's restart budget then governs what happens).
+_breaker = CircuitBreaker(threshold=8, cooldown_s=5.0, name="storage")
+
+
+def _policy() -> RetryPolicy:
+    return RetryPolicy.from_config("storage.retry")
+
+
+def reset_retry_state() -> None:
+    """Close the storage circuit (tests isolate retry state per test)."""
+    _breaker.reset()
+
+
+def _guarded(site: str, key: str, fn: Callable):
+    """Run one storage operation behind the shared retry layer, with the
+    fault point INSIDE the retried callable so injected transient faults
+    recover in place (no job restart)."""
+
+    def _once():
+        fault_point(site, key=key)
+        return fn()
+
+    return retry_call(_once, policy=_policy(), retry_on=default_transient,
+                      description=f"{site} {key}", breaker=_breaker)
 
 
 def set_s3_client(client) -> None:
@@ -75,18 +106,31 @@ class GcsHttpClient:
     otherwise (public buckets / emulators). Endpoint overridable for
     fake-gcs-server style emulators via STORAGE_EMULATOR_HOST."""
 
+    # refresh this many seconds before the token's stated expiry
+    TOKEN_REFRESH_MARGIN_S = 120.0
+
     def __init__(self, endpoint: Optional[str] = None, timeout: float = 20.0):
         self.endpoint = (endpoint or os.environ.get("STORAGE_EMULATOR_HOST")
                          or "https://storage.googleapis.com").rstrip("/")
         self.timeout = timeout
         self._token: Optional[str] = os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN")
+        self._token_source = "env" if self._token else None
+        self._token_expiry: Optional[float] = None  # monotonic deadline
         self._probed_metadata = False
+
+    def _token_stale(self) -> bool:
+        return (self._token_expiry is not None
+                and time.monotonic() >= self._token_expiry - self.TOKEN_REFRESH_MARGIN_S)
 
     def _headers(self) -> dict:
         if self._token is None and not self._probed_metadata:
             # probe the metadata server ONCE; off-GCE hosts must not pay a
             # 2s timeout per storage operation
             self._probed_metadata = True
+            self._metadata_token()
+        elif self._token_source == "metadata" and self._token_stale():
+            # GCE access tokens expire (~1h): proactively re-fetch near
+            # expiry so long-running checkpoint streams never see the 401
             self._metadata_token()
         return {"Authorization": f"Bearer {self._token}"} if self._token else {}
 
@@ -100,21 +144,58 @@ class GcsHttpClient:
                 "service-accounts/default/token",
                 headers={"Metadata-Flavor": "Google"})
             with urllib.request.urlopen(req, timeout=2) as r:
-                self._token = _json.loads(r.read())["access_token"]
+                payload = _json.loads(r.read())
+                self._token = payload["access_token"]
+                self._token_source = "metadata"
+                expires_in = payload.get("expires_in")
+                self._token_expiry = (
+                    time.monotonic() + float(expires_in) if expires_in else None)
                 return self._token
         except Exception:  # noqa: BLE001 - not on GCE
             return None
 
+    def _refresh_token(self) -> bool:
+        """Force-refresh after an auth failure: re-read the env var (it may
+        have been rotated in place) and re-probe the metadata server even if
+        an earlier probe failed. True if a (possibly new) token is held."""
+        before = self._token
+        env = os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN")
+        if env and env != self._token:
+            self._token = env
+            self._token_source = "env"
+            self._token_expiry = None
+            return True
+        self._probed_metadata = True
+        self._metadata_token()
+        return self._token is not None and self._token != before
+
     def _call(self, method: str, url: str, data: Optional[bytes] = None,
               content_type: Optional[str] = None) -> bytes:
-        import urllib.request
+        # transient (5xx/429/network) retries belong to the shared layer
+        # wrapping the public storage ops (_guarded) — retrying here too
+        # would compound the schedules into attempts^2 during an outage.
+        # This layer only owns the auth lifecycle: refresh-once on 401/403.
+        import urllib.error
 
-        headers = self._headers()
-        if content_type:
-            headers["Content-Type"] = content_type
-        req = urllib.request.Request(url, data=data, method=method, headers=headers)
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return r.read()
+        def _once() -> bytes:
+            import urllib.request
+
+            headers = self._headers()
+            if content_type:
+                headers["Content-Type"] = content_type
+            req = urllib.request.Request(url, data=data, method=method,
+                                         headers=headers)
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.read()
+
+        try:
+            return _once()
+        except urllib.error.HTTPError as e:
+            if e.code in (401, 403) and self._refresh_token():
+                # expired/rotated credentials: retry exactly once with the
+                # fresh token; a second auth failure is a real config error
+                return _once()
+            raise
 
     @staticmethod
     def _q(name: str) -> str:
@@ -196,14 +277,17 @@ def _local(path: str) -> str:
 
 
 def read_bytes(path: str) -> bytes:
-    s3 = _parse_s3(path)
-    if s3:
-        return _get_s3().get_object(Bucket=s3[0], Key=s3[1])["Body"].read()
-    gcs = _parse_gcs(path)
-    if gcs:
-        return _get_gcs().download(gcs[0], gcs[1])
-    with open(_local(path), "rb") as f:
-        return f.read()
+    def _do() -> bytes:
+        s3 = _parse_s3(path)
+        if s3:
+            return _get_s3().get_object(Bucket=s3[0], Key=s3[1])["Body"].read()
+        gcs = _parse_gcs(path)
+        if gcs:
+            return _get_gcs().download(gcs[0], gcs[1])
+        with open(_local(path), "rb") as f:
+            return f.read()
+
+    return _guarded("storage.get", path, _do)
 
 
 def _multipart_threshold() -> int:
@@ -237,6 +321,7 @@ def _s3_multipart_put(client, bucket: str, key: str, data: bytes,
         parts = []
         num = 1
         for off in range(0, len(data), part_size):
+            fault_point("storage.multipart", key=key, part=num)
             r = client.upload_part(
                 Bucket=bucket, Key=key, UploadId=upload_id, PartNumber=num,
                 Body=data[off:off + part_size])
@@ -256,25 +341,28 @@ def _s3_multipart_put(client, bucket: str, key: str, data: bytes,
 
 
 def write_bytes(path: str, data: bytes) -> None:
-    s3 = _parse_s3(path)
-    if s3:
-        client = _get_s3()
-        threshold = _multipart_threshold()
-        if (len(data) > threshold
-                and hasattr(client, "create_multipart_upload")):
-            _s3_multipart_put(client, s3[0], s3[1], data, _multipart_part_size())
-        else:
-            client.put_object(Bucket=s3[0], Key=s3[1], Body=data)
-        return
-    gcs = _parse_gcs(path)
-    if gcs:
-        _get_gcs().upload(gcs[0], gcs[1], data)
-        return
-    p = _local(path)
-    tmp = p + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, p)
+    def _do() -> None:
+        s3 = _parse_s3(path)
+        if s3:
+            client = _get_s3()
+            threshold = _multipart_threshold()
+            if (len(data) > threshold
+                    and hasattr(client, "create_multipart_upload")):
+                _s3_multipart_put(client, s3[0], s3[1], data, _multipart_part_size())
+            else:
+                client.put_object(Bucket=s3[0], Key=s3[1], Body=data)
+            return
+        gcs = _parse_gcs(path)
+        if gcs:
+            _get_gcs().upload(gcs[0], gcs[1], data)
+            return
+        p = _local(path)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)
+
+    _guarded("storage.put", path, _do)
 
 
 def read_text(path: str) -> str:
@@ -325,21 +413,28 @@ def exists(path: str) -> bool:
 
 
 def isdir(path: str) -> bool:
-    s3 = _parse_s3(path)
-    if s3:
-        bucket, key = s3
-        resp = _get_s3().list_objects_v2(
-            Bucket=bucket, Prefix=key + "/", MaxKeys=1)
-        return resp.get("KeyCount", len(resp.get("Contents", []))) > 0
-    gcs = _parse_gcs(path)
-    if gcs:
-        names, prefixes = _get_gcs().list(gcs[0], gcs[1] + "/")
-        return bool(names or prefixes)
-    return os.path.isdir(_local(path))
+    def _do() -> bool:
+        s3 = _parse_s3(path)
+        if s3:
+            bucket, key = s3
+            resp = _get_s3().list_objects_v2(
+                Bucket=bucket, Prefix=key + "/", MaxKeys=1)
+            return resp.get("KeyCount", len(resp.get("Contents", []))) > 0
+        gcs = _parse_gcs(path)
+        if gcs:
+            names, prefixes = _get_gcs().list(gcs[0], gcs[1] + "/")
+            return bool(names or prefixes)
+        return os.path.isdir(_local(path))
+
+    return _guarded("storage.list", path, _do)
 
 
 def listdir(path: str) -> list[str]:
     """Immediate children (files and sub-prefixes), names only."""
+    return _guarded("storage.list", path, lambda: _listdir_once(path))
+
+
+def _listdir_once(path: str) -> list[str]:
     s3 = _parse_s3(path)
     if s3:
         bucket, key = s3
@@ -371,15 +466,18 @@ def listdir(path: str) -> list[str]:
 
 
 def remove(path: str) -> None:
-    s3 = _parse_s3(path)
-    if s3:
-        _get_s3().delete_object(Bucket=s3[0], Key=s3[1])
-        return
-    gcs = _parse_gcs(path)
-    if gcs:
-        _get_gcs().delete(gcs[0], gcs[1])
-        return
-    os.remove(_local(path))
+    def _do() -> None:
+        s3 = _parse_s3(path)
+        if s3:
+            _get_s3().delete_object(Bucket=s3[0], Key=s3[1])
+            return
+        gcs = _parse_gcs(path)
+        if gcs:
+            _get_gcs().delete(gcs[0], gcs[1])
+            return
+        os.remove(_local(path))
+
+    _guarded("storage.delete", path, _do)
 
 
 def rmtree(path: str) -> None:

@@ -367,12 +367,28 @@ class TableManager:
         watermarks = [m["watermark_micros"] for m in metas if m.get("watermark_micros") is not None]
         restored_wm = min(watermarks) if watermarks else None
         spec_by_name = {s.name: s for s in table_specs}
-        by_table: dict[str, list[tuple[str, dict]]] = {}
+        by_table: dict[str, list[tuple[str, dict, str]]] = {}
         for m in metas:
             for fmeta in m["files"]:
                 by_table.setdefault(fmeta["table"], []).append(
-                    (os.path.join(m["__dir__"], fmeta["file"]), fmeta)
+                    (os.path.join(m["__dir__"], fmeta["file"]), fmeta, m["__dir__"])
                 )
+        # crash-consistent compaction rule: once a checkpoint dir holds a
+        # generation>=1 (merged) entry for a table, that dir's generation-0
+        # entries are stale leftovers of a compaction torn mid-rewrite — the
+        # merged file already holds their rows, so reading both would
+        # double-count state. Scoped per directory: the "final" snapshot dir
+        # is never compacted, and its gen-0 state must survive a compacted
+        # epoch dir sitting next to it.
+        for tname, entries in list(by_table.items()):
+            compacted_dirs = {d for _p, fm, d in entries
+                              if int(fm.get("generation", 0)) >= 1}
+            if compacted_dirs:
+                by_table[tname] = [
+                    (p, fm, d) for p, fm, d in entries
+                    if int(fm.get("generation", 0)) >= 1 or d not in compacted_dirs
+                ]
+        by_table = {t: [(p, fm) for p, fm, _d in es] for t, es in by_table.items()}
         for tname, entries in by_table.items():
             spec = spec_by_name.get(tname)
             kind = entries[0][1].get("kind")
@@ -397,6 +413,13 @@ def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
     cleared (their watermarks are preserved), so a later restore reads the
     data exactly once and re-shards it by routing-key range.
     Returns the number of files merged away.
+
+    Crash consistency (proved by the chaos suite): the generation-1 holder's
+    metadata write is the single atomic commit point. It lands FIRST; restore
+    ignores every generation-0 entry for a table once any generation>=1 entry
+    exists (TableManager.restore), so a crash at any point leaves the epoch
+    restorable without loss or double-reads. A re-run of compaction after a
+    torn crash finishes the cleanup instead of re-merging.
     """
     opdir = operator_dir(storage_url, job_id, epoch, node_id)
     if not storage.isdir(opdir):
@@ -405,13 +428,46 @@ def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
     for fn in storage.listdir(opdir):
         if fn.startswith("metadata-") and fn.endswith(".json"):
             metas.append((fn, json.loads(storage.read_text(os.path.join(opdir, fn)))))
+    if not metas:
+        return 0
+    removed = 0
+    # orphan sweep: a table file no metadata references is a leftover of a
+    # torn compaction — a stale gen-0 shard de-listed before its deletion
+    # step ran, or an uncommitted merged file (about to be re-merged).
+    # Either way its live rows are owned elsewhere, so it is garbage.
+    referenced = {fm["file"] for _fn, m in metas for fm in m["files"]}
+    for fn in storage.listdir(opdir):
+        if fn.startswith("table-") and fn not in referenced:
+            try:
+                storage.remove(os.path.join(opdir, fn))
+                removed += 1
+            except FileNotFoundError:
+                pass
+    # resume a torn compaction: a generation>=1 entry anywhere means the
+    # switch already committed for that table — finish the cleanup (drop
+    # stale gen-0 entries elsewhere, delete their shard files); re-merging
+    # would clobber the live merged file with partial data
+    done_tables = {fm["table"] for _fn, m in metas for fm in m["files"]
+                   if int(fm.get("generation", 0)) >= 1}
+    for fn, m in metas:
+        stale = [fm for fm in m["files"]
+                 if fm["table"] in done_tables and int(fm.get("generation", 0)) == 0]
+        if stale:
+            m["files"] = [fm for fm in m["files"] if fm not in stale]
+            storage.write_text(os.path.join(opdir, fn), json.dumps(m))
+            for fm in stale:
+                try:
+                    storage.remove(os.path.join(opdir, fm["file"]))
+                    removed += 1
+                except FileNotFoundError:
+                    pass
     by_table: dict[str, list[dict]] = {}
     for _fn, m in metas:
         for fmeta in m["files"]:
-            if int(fmeta.get("generation", 0)) == 0:
+            if (int(fmeta.get("generation", 0)) == 0
+                    and fmeta["table"] not in done_tables):
                 by_table.setdefault(fmeta["table"], []).append(fmeta)
     merged_files: dict[str, dict] = {}
-    removed = 0
     ext = "parquet" if _checkpoint_format() == "parquet" else "npz"
     for tname, fmetas in by_table.items():
         if len(fmetas) < 2:
@@ -440,17 +496,24 @@ def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
         merged["generation"] = 1
         merged_files[tname] = merged
     if not merged_files:
-        return 0
-    # crash safety: merged files and rewritten metadata land BEFORE the old
-    # shards are deleted — an interruption leaves a restorable epoch either
-    # way (at worst both copies exist; gen-0 entries were already dropped
-    # from metadata so nothing is read twice)
-    for fn, m in metas:
+        return removed
+    # crash safety, in commit order:
+    #   1. merged data files are fully written (above) — orphans if we die
+    #   2. the g1-holder metadata lands FIRST (atomic publish): from this
+    #      instant restore prefers generation-1 and ignores stale gen-0
+    #      entries still listed by other subtasks
+    #   3. remaining metadata rewrites drop their gen-0 entries
+    #   4. old shard files are deleted last
+    # dying between any two steps leaves the epoch restorable with neither
+    # loss nor double-reads.
+    holder = min(mm["subtask_index"] for _f, mm in metas)
+    ordered = sorted(metas, key=lambda fm_m: fm_m[1]["subtask_index"] != holder)
+    for fn, m in ordered:
         kept = [
             fm for fm in m["files"]
             if fm["table"] not in merged_files or int(fm.get("generation", 0)) > 0
         ]
-        if m["subtask_index"] == min(mm["subtask_index"] for _f, mm in metas):
+        if m["subtask_index"] == holder:
             kept.extend(merged_files.values())
         m["files"] = kept
         storage.write_text(os.path.join(opdir, fn), json.dumps(m))

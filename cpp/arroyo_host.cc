@@ -10,7 +10,7 @@
 //                                    header + payload per frame)
 //
 // Exposed as a plain C ABI consumed via ctypes (arroyo_tpu/native). The
-// compute path stays JAX/XLA/Pallas; this library owns the byte-shoveling
+// compute path stays JAX/XLA; this library owns the byte-shoveling
 // around it.
 
 #include <cerrno>

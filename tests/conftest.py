@@ -24,6 +24,8 @@ import pytest  # noqa: E402
 def _storage(tmp_path, monkeypatch):
     """Point checkpoint storage at a fresh tmp dir for every test."""
     from arroyo_tpu import config as cfg
+    from arroyo_tpu import faults
+    from arroyo_tpu.state import storage as _st
 
     cfg.reset()
     cfg.update({
@@ -33,8 +35,13 @@ def _storage(tmp_path, monkeypatch):
         "device.batch-capacity": 1024,
         "device.emit-capacity": 1024,
         "device.max-probes": 32,
+        # chaos runs use sub-second retry delays; production default is 50ms
+        "storage.retry.base-delay-ms": 10,
     })
     yield str(tmp_path / "checkpoints")
+    # fault plans and storage circuit state never leak across tests
+    faults.clear()
+    _st.reset_retry_state()
     cfg.reset()
 
 
@@ -48,3 +55,29 @@ def _operators():
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "no_native_required: runs even when the native library is unavailable")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection suite (runs pipelines under induced "
+                   "failures and asserts byte-exact recovery)")
+    config.addinivalue_line(
+        "markers", "slow: long soak tests excluded from the tier-1 budget")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """On any failure while a fault plan is active, print the plan + seed
+    (and which faults fired) so the chaos run can be replayed exactly."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when == "call" and rep.failed:
+        try:
+            from arroyo_tpu import faults
+
+            inj = faults.active()
+            if inj is not None:
+                fired = "\n".join(inj.fired_log[-20:]) or "(no faults fired)"
+                rep.sections.append((
+                    "fault injection",
+                    f"plan={inj.plan!r} seed={inj.seed}\nfired:\n{fired}",
+                ))
+        except Exception:
+            pass
