@@ -1,0 +1,56 @@
+"""Static analysis: plan-time dataflow validation + repo lint.
+
+Two engines share one diagnostic model (``diagnostics.Diagnostic``):
+
+- **Plan analyzer** (``plan_passes``): passes over the logical dataflow
+  graph, run automatically at the end of SQL planning and exposed as
+  ``python -m arroyo_tpu check <pipeline.sql>``. ERROR findings reject the
+  pipeline at plan time — before state allocation or device compilation —
+  matching the reference planner's ``--fail`` SQL tests.
+- **Repo lint** (``repo_lint``): AST checks over this codebase encoding
+  invariants earlier PRs paid to learn (shared retry layer, no swallowed
+  exceptions, determinism, no host-sync in hot paths, lock discipline,
+  fault-site coverage). ``python -m arroyo_tpu lint`` / ``tools/lint.sh``;
+  CI keeps it at zero unwaived findings.
+
+See the README "Static analysis" section for the rule catalog, example
+diagnostics, and how to add a pass or waive a finding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .diagnostics import (  # noqa: F401
+    AnalysisError,
+    Diagnostic,
+    Severity,
+    finish,
+    render_report,
+    worst,
+)
+from .plan_passes import PLAN_PASSES, PassContext, analyze_graph  # noqa: F401
+from .repo_lint import RULES as LINT_RULES  # noqa: F401
+from .repo_lint import lint_paths, lint_source  # noqa: F401
+
+
+def check_sql(sql: str, parallelism: int = 1):
+    """Plan ``sql`` and run every analyzer pass, collecting ALL diagnostics
+    instead of raising on the first error (the ``check`` CLI surface).
+
+    Returns ``(planned_pipeline_or_None, diagnostics)``; the pipeline is
+    None when planning itself fails (those failures surface as an AR000
+    diagnostic so check output always speaks rule ids).
+    """
+    from ..sql.lexer import SqlError
+    from ..sql.planner import plan_query
+
+    try:
+        pp = plan_query(sql, parallelism=parallelism, analyze=False)
+    except AnalysisError as e:  # pragma: no cover - analyze=False skips this
+        return None, e.diagnostics
+    except SqlError as e:
+        return None, [Diagnostic("AR000", Severity.ERROR, "<plan>", str(e),
+                                 "fix the SQL; this failure precedes graph "
+                                 "analysis")]
+    return pp, analyze_graph(pp.graph)

@@ -1,0 +1,461 @@
+"""Repo lint engine: AST checks encoding invariants this repo paid to learn.
+
+Each rule exists because its violation has already cost a debugging session
+here (see CHANGES.md): ad-hoc sleep loops hid unrecoverable retries until
+the chaos suite replaced them with the shared layer; a swallowed exception
+let a crashed pipeline report success; an unseeded random in an operator
+made replay nondeterministic; a peer dial under the connection-map lock
+stalled every sender. The linter makes the lesson structural.
+
+Rule catalog:
+
+    LR101 ad-hoc-retry-sleep   ``time.sleep`` inside an except handler whose
+                               delay does not come from the shared
+                               utils/retry layer (Backoff.next_delay)
+    LR102 swallowed-exception  bare ``except:`` anywhere; ``except
+                               (Base)Exception: pass`` in engine/state/
+                               connector/controller code
+    LR103 unseeded-random      module-level random / np.random calls in
+                               operator or engine code (replay determinism)
+    LR104 host-sync-hot-path   ``.block_until_ready()`` / ``float()`` /
+                               ``np.asarray`` on device values inside
+                               operator ``process_batch`` hot paths
+    LR105 lock-across-blocking ``with <lock>:`` regions containing blocking
+                               calls (sleep/socket/storage/queue) in the
+                               threaded engine
+    LR106 fault-site-coverage  storage/network/queue mutations must route
+                               through ``faults`` hooks; every declared
+                               fault site must be wired somewhere
+
+Waivers: append ``# lint: waive LR1xx — justification`` on the flagged
+line (or the line above). A waiver with no justification text does not
+suppress the finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from .diagnostics import Diagnostic, Severity, finish
+
+_WAIVE_RE = re.compile(r"lint:\s*waive\s+(LR\d+)\s*(?:[-—:,]\s*)?(.*)", re.I)
+
+
+@dataclass
+class ModuleInfo:
+    relpath: str  # forward-slash path relative to the repo/package root
+    tree: ast.AST
+    comments: dict[int, str] = field(default_factory=dict)  # line -> text
+
+    def in_dirs(self, *dirs: str) -> bool:
+        parts = self.relpath.split("/")
+        return any(d in parts for d in dirs)
+
+    def waiver(self, line: int, rule_id: str) -> Optional[str]:
+        """Justification text if a valid waiver covers (line, rule)."""
+        for ln in (line, line - 1):
+            m = _WAIVE_RE.search(self.comments.get(ln, ""))
+            if m and m.group(1).upper() == rule_id and m.group(2).strip():
+                return m.group(2).strip()
+        return None
+
+
+def _parse(source: str, relpath: str) -> ModuleInfo:
+    info = ModuleInfo(relpath.replace(os.sep, "/"), ast.parse(source))
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                info.comments[tok.start[0]] = tok.string
+    except tokenize.TokenError:
+        pass
+    return info
+
+
+# ------------------------------------------------------------- AST helpers
+
+
+def _call_name(call: ast.Call) -> str:
+    """Trailing identifier of the called expression ('sleep', 'put', ...)."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _receiver_name(call: ast.Call) -> str:
+    """Identifier the method is called on ('time' in time.sleep, '_out' in
+    self._out.get); empty for plain names."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        v = f.value
+        if isinstance(v, ast.Name):
+            return v.id
+        if isinstance(v, ast.Attribute):
+            return v.attr
+    return ""
+
+
+def _dotted(expr: ast.expr) -> str:
+    """Best-effort dotted name ('np.random.uniform')."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+def _mentions_lock(expr: ast.expr) -> bool:
+    for n in ast.walk(expr):
+        ident = None
+        if isinstance(n, ast.Name):
+            ident = n.id
+        elif isinstance(n, ast.Attribute):
+            ident = n.attr
+        if ident is not None and "lock" in ident.lower():
+            return True
+    return False
+
+
+def _walk_skipping_nested_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a statement body without descending into nested function/class
+    defs (their bodies execute later, outside the enclosing region)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+Finding = tuple[int, str, str]  # line, message, hint
+
+
+# ------------------------------------------------------------------- rules
+
+
+def rule_lr101(mod: ModuleInfo) -> Iterable[Finding]:
+    """time.sleep inside an except handler = a hand-rolled retry backoff,
+    unless the delay comes from the shared retry layer."""
+    if mod.relpath.endswith("utils/retry.py"):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        for n in ast.walk(node):
+            if not (isinstance(n, ast.Call) and _call_name(n) == "sleep"
+                    and _receiver_name(n) in ("time", "_time")):
+                continue
+            from_shared = any(
+                isinstance(a, ast.Call) and _call_name(a) == "next_delay"
+                for arg in n.args for a in ast.walk(arg)
+            )
+            if not from_shared:
+                yield (n.lineno,
+                       "ad-hoc retry backoff: time.sleep inside an except "
+                       "handler with a delay not drawn from the shared retry "
+                       "layer",
+                       "use utils/retry.py (retry_call, or Backoff.next_delay "
+                       "for loops)")
+
+
+def rule_lr102(mod: ModuleInfo) -> Iterable[Finding]:
+    """Bare except anywhere; silently-swallowed broad except in the
+    engine/state/connector/controller layers."""
+    strict_scope = mod.in_dirs("engine", "state", "connectors", "controller")
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            yield (node.lineno,
+                   "bare except: catches KeyboardInterrupt/SystemExit and "
+                   "hides programming errors",
+                   "catch Exception (or the specific errors) instead")
+            continue
+        if not strict_scope:
+            continue
+        broad = isinstance(node.type, ast.Name) and node.type.id in (
+            "Exception", "BaseException")
+        swallows = len(node.body) == 1 and isinstance(node.body[0], ast.Pass)
+        if broad and swallows:
+            yield (node.lineno,
+                   "swallowed exception: broad except with a bare `pass` in "
+                   "engine/state/connector code can hide real failures "
+                   "(a crashed pipeline once reported success this way)",
+                   "log it, narrow the type, or waive with justification if "
+                   "failure here is genuinely unactionable")
+
+
+_RANDOM_FNS = {"random", "randrange", "randint", "uniform", "choice",
+               "choices", "shuffle", "sample", "normal", "rand", "randn"}
+
+
+def rule_lr103(mod: ModuleInfo) -> Iterable[Finding]:
+    """Module-level random/np.random draws in operator or engine code break
+    replay determinism (checkpoint recovery re-executes these paths)."""
+    if not mod.in_dirs("operators", "ops", "windows", "parallel", "engine"):
+        return
+    for n in ast.walk(mod.tree):
+        if not isinstance(n, ast.Call):
+            continue
+        dn = _dotted(n.func)
+        if dn.startswith(("random.", "np.random.", "numpy.random.")) and \
+                dn.rsplit(".", 1)[-1] in _RANDOM_FNS:
+            yield (n.lineno,
+                   f"unseeded {dn}() in operator/engine code: output differs "
+                   "across replays, so checkpoint recovery is no longer "
+                   "byte-exact",
+                   "derive the value deterministically (task identity, "
+                   "config seed) or use a seeded Random instance")
+
+
+def rule_lr104(mod: ModuleInfo) -> Iterable[Finding]:
+    """Host-sync in the per-batch hot path: block_until_ready anywhere in
+    operator code; float()/np.asarray()/np.array() applied to values that
+    came off the device inside process_batch."""
+    if not mod.in_dirs("operators", "ops", "windows", "parallel"):
+        return
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Call) and _call_name(n) == "block_until_ready":
+            yield (n.lineno,
+                   ".block_until_ready() in operator code forces a host sync "
+                   "per batch, serializing the device pipeline",
+                   "let values stay on device; sync only at sinks or "
+                   "checkpoint boundaries")
+    for fn in ast.walk(mod.tree):
+        if not (isinstance(fn, ast.FunctionDef)
+                and fn.name in ("process_batch", "process_batches")):
+            continue
+        device_names: set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                produces_device = any(
+                    isinstance(c, ast.Call) and (
+                        _call_name(c) == "eval_jnp"
+                        or _dotted(c.func).startswith(("jnp.", "jax."))
+                    )
+                    for c in ast.walk(n.value)
+                )
+                if produces_device:
+                    device_names.add(n.targets[0].id)
+        if not device_names:
+            continue
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call) or not n.args:
+                continue
+            arg0 = n.args[0]
+            if not (isinstance(arg0, ast.Name) and arg0.id in device_names):
+                continue
+            dn = _dotted(n.func)
+            if dn == "float" or dn in ("np.asarray", "np.array", "numpy.asarray",
+                                       "numpy.array"):
+                yield (n.lineno,
+                       f"{dn}() on a device value inside {fn.name}: forces a "
+                       "blocking device->host transfer in the per-batch hot "
+                       "path",
+                       "keep the value in jnp, or move the transfer to flush/"
+                       "checkpoint time")
+
+
+_LR105_BLOCKING = {"sleep", "sendall", "recv", "accept", "connect",
+                   "urlopen", "check_output", "put_bytes", "get_bytes",
+                   "read_bytes", "write_bytes"}
+
+
+def rule_lr105(mod: ModuleInfo) -> Iterable[Finding]:
+    """Blocking calls inside a with-lock region of the threaded engine:
+    every other thread contending that lock stalls for the full call."""
+    if not mod.in_dirs("engine", "state", "controller"):
+        return
+    # with-lock region map: every `with <...lock...>:` statement body
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.With):
+            continue
+        if not any(_mentions_lock(item.context_expr) for item in node.items):
+            continue
+        for n in _walk_skipping_nested_defs(node):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _call_name(n)
+            recv = _receiver_name(n)
+            blocking = name in _LR105_BLOCKING
+            if name == "join" and recv not in ("path", "os"):
+                # thread/process join; os.path.join and "".join are not
+                blocking = not isinstance(
+                    getattr(n.func, "value", None), ast.Constant)
+            if name in ("get", "put") and (
+                    "queue" in recv.lower() or "inbox" in recv.lower()):
+                blocking = not any(
+                    isinstance(k.value, ast.Constant) and k.value.value is False
+                    for k in n.keywords if k.arg == "block"
+                )
+            if blocking:
+                yield (n.lineno,
+                       f"blocking call {name}() while holding a lock "
+                       f"(with-lock region at line {node.lineno}): all "
+                       "contending threads stall for the full call",
+                       "move the blocking call outside the lock (copy state "
+                       "under the lock, act on it after release)")
+
+
+# file-suffix -> (functions that mutate storage/network/queues, gateways
+# that count as routing through the fault layer)
+_LR106_TARGETS = {
+    "state/storage.py": (
+        ("read_bytes", "write_bytes", "read_text", "write_text", "exists",
+         "isdir", "listdir", "remove", "rmtree"),
+        ("fault_point", "_guarded"),
+    ),
+    "engine/network.py": (
+        ("put", "_read_loop"),
+        ("fault_point",),
+    ),
+    "engine/queues.py": (
+        ("put",),
+        ("fault_point",),
+    ),
+}
+
+
+def rule_lr106(mod: ModuleInfo) -> Iterable[Finding]:
+    """Every storage/network/queue mutation must route through the faults
+    hooks — otherwise the chaos suite silently stops covering it."""
+    target = next((v for k, v in _LR106_TARGETS.items()
+                   if mod.relpath.endswith(k)), None)
+    if target is None:
+        return
+    required, gateways = target
+    # intra-module call graph over every function (methods by bare name)
+    funcs: dict[str, list[ast.FunctionDef]] = {}
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.FunctionDef):
+            funcs.setdefault(n.name, []).append(n)
+
+    def reaches_gateway(name: str, seen: set[str]) -> bool:
+        if name in seen:
+            return False
+        seen.add(name)
+        for fn in funcs.get(name, []):
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    cn = _call_name(n)
+                    if cn in gateways:
+                        return True
+                    if cn in funcs and reaches_gateway(cn, seen):
+                        return True
+        return False
+
+    for name in required:
+        for fn in funcs.get(name, []):
+            if not reaches_gateway(name, set()):
+                yield (fn.lineno,
+                       f"{name}() mutates storage/network/queue state but "
+                       "never routes through a faults hook; chaos tests "
+                       "cannot exercise its failure path",
+                       "call faults.fault_point(...) (directly or via the "
+                       "module's guarded helper) inside the operation")
+
+
+RULES: tuple[tuple[str, Severity, object], ...] = (
+    ("LR101", Severity.ERROR, rule_lr101),
+    ("LR102", Severity.ERROR, rule_lr102),
+    ("LR103", Severity.ERROR, rule_lr103),
+    ("LR104", Severity.WARNING, rule_lr104),
+    ("LR105", Severity.ERROR, rule_lr105),
+    ("LR106", Severity.ERROR, rule_lr106),
+)
+
+# fault sites every full-package lint must find wired (mirrors faults.SITES;
+# a literal copy so the linter itself has no runtime imports of the engine)
+_DECLARED_FAULT_SITES = (
+    "storage.put", "storage.get", "storage.delete", "storage.list",
+    "storage.multipart", "network.send", "network.recv", "queue.put",
+    "connector.poll", "connector.commit", "worker", "worker.heartbeat",
+    "node.start_worker",
+)
+
+
+def lint_module(mod: ModuleInfo) -> list[Diagnostic]:
+    """Run every rule over one parsed module; waived findings suppressed."""
+    out: list[Diagnostic] = []
+    for rule_id, sev, rule in RULES:
+        for line, message, hint in rule(mod):
+            if mod.waiver(line, rule_id):
+                continue
+            out.append(Diagnostic(rule_id, sev, f"{mod.relpath}:{line}",
+                                  message, hint))
+    return out
+
+
+def lint_source(source: str, relpath: str) -> list[Diagnostic]:
+    """Lint one file's text."""
+    return lint_module(_parse(source, relpath))
+
+
+def _site_literals(tree: ast.AST) -> set[str]:
+    # sites reach fault_point either directly or through a module's guarded
+    # gateway (storage.py's _guarded), which takes the site as its first arg
+    out = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and _call_name(n) in ("fault_point", "_guarded") \
+                and n.args:
+            a = n.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                out.add(a.value)
+    return out
+
+
+def lint_paths(paths: list[str], root: Optional[str] = None) -> list[Diagnostic]:
+    """Lint every .py file under ``paths`` (files or directories).
+
+    When the sweep includes the faults package itself (i.e. a whole-package
+    run), additionally checks that every declared fault site is wired at
+    least once somewhere in the sweep (LR106)."""
+    root = os.path.abspath(root or os.getcwd())
+    files: list[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames) if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    diags: list[Diagnostic] = []
+    wired_sites: set[str] = set()
+    saw_faults_pkg = False
+    for f in files:
+        rel = os.path.relpath(f, root).replace(os.sep, "/")
+        with open(f) as fh:
+            src = fh.read()
+        try:
+            mod = _parse(src, rel)
+        except SyntaxError as e:
+            diags.append(Diagnostic("LR000", Severity.ERROR, f"{rel}:{e.lineno or 0}",
+                                    f"file does not parse: {e.msg}"))
+            continue
+        diags.extend(lint_module(mod))
+        wired_sites |= _site_literals(mod.tree)
+        if rel.endswith("faults/__init__.py"):
+            saw_faults_pkg = True
+    if saw_faults_pkg:
+        for site in _DECLARED_FAULT_SITES:
+            if site not in wired_sites:
+                diags.append(Diagnostic(
+                    "LR106", Severity.ERROR, "arroyo_tpu/faults/__init__.py:1",
+                    f"declared fault site {site!r} has no fault_point call "
+                    "site anywhere in the package",
+                    "wire the site or remove it from faults.SITES"))
+    return finish(diags)

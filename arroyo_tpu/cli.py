@@ -34,6 +34,46 @@ def _cmd_visualize(args) -> int:
     return 0
 
 
+def _cmd_check(args) -> int:
+    """Static analysis of a pipeline without running it: plan the SQL, run
+    every analyzer pass (arroyo_tpu.analysis), print the full diagnostic
+    report. Exit 0 = clean (warnings allowed unless --strict), 1 = rejected."""
+    import arroyo_tpu
+    from arroyo_tpu.analysis import Severity, check_sql, render_report
+
+    arroyo_tpu._load_operators()
+    with open(args.sql_file) as f:
+        sql = f.read()
+    pp, diags = check_sql(sql, parallelism=args.parallelism)
+    if diags:
+        print(render_report(diags))
+    if any(d.severity == Severity.ERROR for d in diags) or pp is None:
+        return 1
+    if pp is not None and not diags:
+        print(f"ok: {len(pp.graph.nodes)} nodes, {len(pp.graph.edges)} edges, "
+              "no findings")
+    if args.strict and diags:
+        return 1
+    return 0
+
+
+def _cmd_lint(args) -> int:
+    """Repo lint: AST checks over this codebase's own invariants (see
+    arroyo_tpu.analysis.repo_lint). Exit 1 on any unwaived finding."""
+    import arroyo_tpu
+    from arroyo_tpu.analysis import lint_paths, render_report
+
+    pkg_dir = os.path.dirname(os.path.abspath(arroyo_tpu.__file__))
+    root = os.path.dirname(pkg_dir)
+    paths = args.paths or [pkg_dir]
+    diags = lint_paths(paths, root=root)
+    if diags:
+        print(render_report(diags))
+        return 1
+    print("lint clean")
+    return 0
+
+
 def _cmd_run(args) -> int:
     import arroyo_tpu
     from arroyo_tpu.api import ApiServer
@@ -43,6 +83,16 @@ def _cmd_run(args) -> int:
     arroyo_tpu._load_operators()
     with open(args.sql_file) as f:
         sql = f.read()
+    # plan (and static-analyze) up front: a rejected pipeline prints its
+    # diagnostics here instead of spinning up a cluster that dies "Failed"
+    from arroyo_tpu.sql import plan_query
+    from arroyo_tpu.sql.lexer import SqlError
+
+    try:
+        plan_query(sql)
+    except SqlError as e:
+        print(f"pipeline rejected at plan time:\n{e}", file=sys.stderr)
+        return 2
     db = Database(args.db or ":memory:")
     api = ApiServer(db, port=args.api_port).start()
     controller = ControllerServer(db, scheduler_for(args.scheduler, db)).start()
@@ -309,6 +359,20 @@ def main(argv: Optional[list[str]] = None) -> int:
     vp = sub.add_parser("visualize", help="print the dataflow graph as dot")
     vp.add_argument("sql_file")
     vp.set_defaults(fn=_cmd_visualize)
+
+    kp = sub.add_parser("check", help="static analysis of a SQL pipeline "
+                                      "(plan + dataflow validation, no run)")
+    kp.add_argument("sql_file")
+    kp.add_argument("--parallelism", type=int, default=1)
+    kp.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too")
+    kp.set_defaults(fn=_cmd_check)
+
+    lp = sub.add_parser("lint", help="repo lint: AST invariant checks over "
+                                     "this codebase (tools/lint.sh entry)")
+    lp.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the arroyo_tpu package)")
+    lp.set_defaults(fn=_cmd_lint)
 
     cs = sub.add_parser("compile-service",
                         help="standalone native-UDF compile service")

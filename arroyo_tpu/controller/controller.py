@@ -228,7 +228,7 @@ class JobController:
                 if self.state == JobState.RESCALING:
                     try:
                         self.handle.kill()
-                    except Exception:
+                    except Exception:  # lint: waive LR102 — best-effort kill of an already-exited worker; no recovery possible
                         pass
                     self.handle = None
                     self._finish_rescale(job)
@@ -242,7 +242,7 @@ class JobController:
                 # pipes); for a finished process this is pure cleanup
                 try:
                     self.handle.kill()
-                except Exception:
+                except Exception:  # lint: waive LR102 — best-effort kill during finished-worker cleanup; process is already gone
                     pass
                 self.handle = None
                 return

@@ -240,7 +240,7 @@ class NodeServer:
                 pass  # controller restart: re-register on next beat
                 try:
                     self._register()
-                except Exception:
+                except Exception:  # lint: waive LR102 — controller restart window: the next heartbeat re-registers; nothing to do here
                     pass
 
     def stop(self) -> None:
@@ -252,6 +252,6 @@ class NodeServer:
                     continue  # in-flight reservation, nothing to kill yet
                 try:
                     w.kill()
-                except Exception:
+                except Exception:  # lint: waive LR102 — best-effort kill at daemon shutdown; worker may already have exited
                     pass
             self._workers.clear()

@@ -87,11 +87,19 @@ class NetworkManager:
     def conn_to(self, worker: int) -> DataPlaneConn:
         with self._out_lock:
             conn = self._out.get(worker)
-            if conn is None:
-                host, port = self.peers[worker]
-                conn = DataPlaneConn.connect(host, port)
-                self._out[worker] = conn
+        if conn is not None:
             return conn
+        # dial outside the lock (LR105): a slow or unreachable peer must not
+        # stall every other sender sharing this manager
+        host, port = self.peers[worker]
+        fresh = DataPlaneConn.connect(host, port)
+        with self._out_lock:
+            conn = self._out.get(worker)
+            if conn is None:
+                self._out[worker] = fresh
+                return fresh
+        fresh.close()  # lost the race; keep the established connection
+        return conn
 
     # ------------------------------------------------------------------
 

@@ -135,16 +135,21 @@ class BinOp(Expr):
                 _is_str(l) or _is_str(r)):
             # object operands (strings / outer-join null padding): SQL
             # three-valued logic — a NULL on either side compares as
-            # unknown, which filters as False, for EVERY comparison op
+            # unknown (NULL), for EVERY comparison op. Projections carry
+            # the NULL through to the sink; filter sites coerce with
+            # np.asarray(..., dtype=bool), where None lands as False, so
+            # WHERE keeps its reject-unknown semantics.
             lo, ro = _as_obj(l, n), _as_obj(r, n)
             null = _null_mask(lo) | _null_mask(ro)
             if null.any():
-                out = np.zeros(n, dtype=bool)
+                out = np.empty(n, dtype=object)
+                out[:] = None
                 ok = ~null
                 if ok.any():
                     fn = _NP_BINOPS[self.op]
                     out[ok] = np.array(
-                        [bool(fn(a, b)) for a, b in zip(lo[ok], ro[ok])])
+                        [bool(fn(a, b)) for a, b in zip(lo[ok], ro[ok])],
+                        dtype=object)
                 return out
             l, r = lo, ro
         return _NP_BINOPS[self.op](l, r)
@@ -183,7 +188,13 @@ class Not(Expr):
     inner: Expr
 
     def eval_np(self, cols, n):
-        return np.logical_not(self.inner.eval_np(cols, n))
+        v = self.inner.eval_np(cols, n)
+        if hasattr(v, "dtype") and v.dtype == object:
+            # three-valued logic: NOT NULL is NULL, not True
+            out = np.empty(len(v), dtype=object)
+            out[:] = [None if x is None else not x for x in v]
+            return out
+        return np.logical_not(v)
 
     def eval_jnp(self, cols):
         import jax.numpy as jnp
@@ -256,7 +267,10 @@ class Case(Expr):
         result = None
         assigned = np.zeros(n, dtype=bool)
         for cond, val in self.branches:
-            c = np.broadcast_to(np.asarray(cond.eval_np(cols, n)), (n,))
+            # conditions may be three-valued (object arrays with None from
+            # NULL comparisons): CASE WHEN NULL takes the branch not
+            c = np.broadcast_to(
+                np.asarray(cond.eval_np(cols, n), dtype=bool), (n,))
             v = val.eval_np(cols, n)
             v = np.broadcast_to(np.asarray(v), (n,)) if not _is_scalar(v) or True else v
             sel = c & ~assigned

@@ -12,7 +12,6 @@ collector remains the cross-process / cross-operator path.
 
 from __future__ import annotations
 
-import random
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Sequence
 
@@ -41,7 +40,10 @@ class Collector:
     def __init__(self, out_edges: list[OutEdge], subtask_index: int):
         self.out_edges = out_edges
         self.subtask_index = subtask_index
-        self._rr_offset = random.randrange(1 << 16)
+        # decorrelate round-robin starts across producers without
+        # randomness (LR103): replays must route identically, or restored
+        # runs diverge from the run that wrote the checkpoint
+        self._rr_offset = (subtask_index * 0x9E3779B1) & 0xFFFF
         self.batches_sent = 0
         self.rows_sent = 0
         self.metrics = None  # TaskMetrics, attached by the owning Task

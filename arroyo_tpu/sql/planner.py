@@ -12,10 +12,13 @@ WindowInfo per planned relation.
 from __future__ import annotations
 
 import itertools
+import logging
 from dataclasses import dataclass, field as dc_field
 from typing import Optional
 
 import numpy as np
+
+_log = logging.getLogger("arroyo_tpu.planner")
 
 from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Field, Schema
 from ..expr import BinOp, Case, Cast, Col, Expr, Func, Lit, Neg, Not
@@ -1350,11 +1353,27 @@ def connection_table_decl(ct: dict) -> TableDecl:
 
 
 def plan_query(sql: str, parallelism: int = 1,
-               connection_tables: Optional[list[dict]] = None) -> PlannedPipeline:
+               connection_tables: Optional[list[dict]] = None,
+               analyze: bool = True) -> PlannedPipeline:
+    """Plan a SQL script; with ``analyze`` (the default) the static plan
+    analyzer (arroyo_tpu.analysis) then validates the graph — ERROR
+    diagnostics raise AnalysisError (a SqlError) before any execution,
+    WARNING diagnostics are logged. Pass analyze=False to collect the full
+    diagnostic list yourself (the `check` CLI does)."""
     p = Planner(parallelism)
     for ct in connection_tables or []:
         p.tables[ct["name"]] = connection_table_decl(ct)
-    return p.plan(sql)
+    pp = p.plan(sql)
+    if analyze:
+        from ..analysis import AnalysisError, Severity, analyze_graph
+
+        diags = analyze_graph(pp.graph)
+        errors = [d for d in diags if d.severity == Severity.ERROR]
+        if errors:
+            raise AnalysisError(errors)
+        for d in diags:
+            _log.warning("plan analysis: %s", d.render())
+    return pp
 
 
 def set_parallelism(graph: Graph, n: int) -> None:

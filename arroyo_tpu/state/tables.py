@@ -120,8 +120,11 @@ def read_columnar(path: str) -> dict:
     if _format_of(path) == "parquet":
         import pyarrow.parquet as pq
 
+        # fetch before taking the parquet lock (LR105): the storage read can
+        # block on the network and must not serialize other readers
+        data = storage.read_bytes(path)
         with _PARQUET_IO_LOCK:
-            table = pq.read_table(io.BytesIO(storage.read_bytes(path)), use_threads=False)
+            table = pq.read_table(io.BytesIO(data), use_threads=False)
         cols: dict[str, np.ndarray] = {}
         for name in table.column_names:
             arr = table.column(name)

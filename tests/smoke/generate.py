@@ -655,6 +655,28 @@ def o_nested_subquery(ins):
     ]
 
 
+def o_cast_to_sink_type(ins):
+    return [
+        {"counter_text": str(r["counter"]),
+         "counter_float": float(r["counter"]),
+         "counter_small": r["counter"]}
+        for r in ins["impulse"]
+    ]
+
+
+def o_null_comparisons(ins):
+    out = []
+    for r in ins["impulse"]:
+        c = r["counter"]
+        if c < 5:
+            out.append({"counter": c, "small": c, "is_gt": c > 2})
+        else:
+            # no right-side match: padding is NULL and the projected
+            # comparison propagates NULL (three-valued logic), not False
+            out.append({"counter": c, "small": None, "is_gt": None})
+    return out
+
+
 ORACLES = {
     "select_star": o_select_star,
     "nexmark_q1": o_nexmark_q1,
@@ -693,6 +715,8 @@ ORACLES = {
     "window_function": o_window_function,
     "union_all": o_union_all,
     "having_filter": o_having_filter,
+    "cast_to_sink_type": o_cast_to_sink_type,
+    "null_comparisons": o_null_comparisons,
 }
 
 # queries whose sinks receive an updating stream (harness debezium-merges
@@ -707,6 +731,7 @@ UPDATING = {
     "updating_inner_join_with_updating",
     "debezium_pass_through",
     "debezium_agg",
+    "null_comparisons",
 }
 
 

@@ -1,0 +1,29 @@
+-- Sink-type coercion end-to-end: the query's BIGINT UNSIGNED output is
+-- positionally cast to each declared sink column type (TEXT / DOUBLE / INT)
+-- by the planner's sink_coerce projection; reference cast_to_sink_type.sql.
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+
+CREATE TABLE cast_output (
+  counter_text TEXT,
+  counter_float DOUBLE,
+  counter_small INT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+
+INSERT INTO cast_output
+SELECT counter, counter, counter
+FROM impulse_source;

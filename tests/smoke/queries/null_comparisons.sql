@@ -1,0 +1,33 @@
+-- Three-valued logic through a projection: comparisons over outer-join
+-- null padding must project NULL (not False); only counters < 5 have a
+-- right-side match, so rows >= 5 sink is_gt = NULL and rows 0..2 / 3..4
+-- exercise the False / True legs of the same comparison.
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+
+CREATE TABLE null_output (
+  counter BIGINT,
+  small BIGINT,
+  is_gt BOOLEAN
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+
+INSERT INTO null_output
+SELECT i.counter, r.c2, r.c2 > 2 AS is_gt
+FROM impulse_source i
+LEFT JOIN (
+  SELECT counter AS c2 FROM impulse_source WHERE counter < 5
+) r ON i.counter = r.c2;

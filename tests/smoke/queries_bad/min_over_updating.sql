@@ -1,0 +1,25 @@
+-- reject: AR000
+-- min() needs an invertible accumulator to survive retractions.
+CREATE TABLE orders_cdc (
+  id INT,
+  customer_name TEXT,
+  product_name TEXT,
+  quantity INT,
+  price DOUBLE,
+  status TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/aggregate_updates.json',
+  format = 'debezium_json',
+  type = 'source'
+);
+CREATE TABLE output (
+  p TEXT, m BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT product_name, min(quantity) FROM orders_cdc GROUP BY product_name;

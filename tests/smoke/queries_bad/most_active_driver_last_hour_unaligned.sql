@@ -1,0 +1,29 @@
+-- reject: AR002
+-- The reference's --fail test: hop() whose slide does not divide the
+-- width must be rejected at plan time, not blow up at runtime.
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE output (
+  start TIMESTAMP, driver_id BIGINT, cnt BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT x.w.start, x.driver_id, x.c FROM (
+  SELECT hop(interval '25 seconds', interval '60 seconds') AS w,
+         driver_id, count(*) AS c
+  FROM cars GROUP BY 1, 2
+) x;

@@ -1,0 +1,25 @@
+-- reject: AR000
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE output (
+  c BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT count(*) FROM (
+  SELECT tumble(interval '10 seconds') AS w, counter, count(*) AS c
+  FROM impulse_source GROUP BY 1, 2
+) x GROUP BY tumble(interval '20 seconds');

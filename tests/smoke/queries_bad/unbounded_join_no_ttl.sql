@@ -1,0 +1,32 @@
+-- warn: AR004
+-- Non-windowed join over unbounded kafka sources with no TTL: both
+-- join-side state tables grow forever.
+CREATE TABLE orders (
+  order_id BIGINT, customer_id BIGINT, amount BIGINT
+) WITH (
+  connector = 'kafka',
+  bootstrap_servers = 'localhost:9092',
+  topic = 'orders',
+  format = 'json',
+  type = 'source'
+);
+CREATE TABLE customers (
+  customer_id BIGINT, name TEXT
+) WITH (
+  connector = 'kafka',
+  bootstrap_servers = 'localhost:9092',
+  topic = 'customers',
+  format = 'json',
+  type = 'source'
+);
+CREATE TABLE output (
+  order_id BIGINT, name TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT o.order_id, c.name FROM orders o
+JOIN customers c ON o.customer_id = c.customer_id;

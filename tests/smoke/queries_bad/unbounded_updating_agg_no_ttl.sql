@@ -1,0 +1,19 @@
+-- warn: AR004
+CREATE TABLE events (
+  user_id BIGINT, kind TEXT
+) WITH (
+  connector = 'kafka',
+  bootstrap_servers = 'localhost:9092',
+  topic = 'events',
+  format = 'json',
+  type = 'source'
+);
+CREATE TABLE output (
+  user_id BIGINT, c BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO output SELECT user_id, count(*) FROM events GROUP BY user_id;

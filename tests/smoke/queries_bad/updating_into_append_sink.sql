@@ -1,0 +1,25 @@
+-- warn: AR005
+-- Updating aggregate into a plain-json sink: rows arrive wrapped in
+-- Debezium envelopes the declared schema does not describe.
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE output (
+  g BIGINT, c BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT CAST(counter % 3 AS BIGINT) AS g, count(*) AS c
+FROM impulse_source GROUP BY 1;

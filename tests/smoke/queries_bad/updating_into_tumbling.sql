@@ -1,0 +1,28 @@
+-- reject: AR000
+-- A retracting (debezium) stream cannot feed an event-time window.
+CREATE TABLE orders_cdc (
+  id INT,
+  customer_name TEXT,
+  product_name TEXT,
+  quantity INT,
+  price DOUBLE,
+  status TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/aggregate_updates.json',
+  format = 'debezium_json',
+  type = 'source'
+);
+CREATE TABLE output (
+  start TIMESTAMP, c BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT x.w.start, x.c FROM (
+  SELECT tumble(interval '10 seconds') AS w, count(*) AS c
+  FROM orders_cdc GROUP BY 1
+) x;

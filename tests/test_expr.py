@@ -48,3 +48,26 @@ def test_jnp_matches_numpy():
     np.testing.assert_allclose(np.asarray(e.eval_jnp(jcols)), ev(e))
     f = Func("abs", (BinOp("-", Col("a"), Lit(2)),))
     np.testing.assert_allclose(np.asarray(f.eval_jnp(jcols)), ev(f))
+
+
+def test_three_valued_comparisons():
+    """NULL operands make comparisons NULL (not False) in projections;
+    NOT propagates NULL; filter-style coercion still rejects unknowns."""
+    cols = {"s": np.array(["a", None, "b"], dtype=object),
+            "t": np.array([None, None, "b"], dtype=object)}
+    gt = eval_expr(BinOp("==", Col("s"), Lit("a")), cols, 3)
+    assert gt.tolist() == [True, None, False]
+    assert eval_expr(BinOp("==", Col("s"), Col("t")), cols, 3).tolist() == \
+        [None, None, True]
+    assert eval_expr(Not(BinOp("==", Col("s"), Lit("a"))), cols, 3).tolist() == \
+        [False, None, True]
+    # WHERE semantics: unknown filters as False
+    assert np.asarray(gt, dtype=bool).tolist() == [True, False, False]
+
+
+def test_case_over_null_comparison():
+    """CASE WHEN <NULL comparison> must treat the unknown as not-taken,
+    not crash on the object condition array."""
+    cols = {"s": np.array(["a", None, "b"], dtype=object)}
+    c = Case(((BinOp("==", Col("s"), Lit("a")), Lit(1)),), Lit(0))
+    assert eval_expr(c, cols, 3).tolist() == [1, 0, 0]

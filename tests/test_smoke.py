@@ -337,6 +337,41 @@ def test_chaos_storage_fail_mid_compaction(name, tmp_path, _storage):
     assert_outputs(name, out)
 
 
+# ------------------------------------------------------------- fail cases
+#
+# Mirror of the reference's --fail SQL tests (arroyo-sql-testing, e.g.
+# most_active_driver_last_hour_unaligned.sql): every 'reject'-annotated
+# pipeline in tests/smoke/queries_bad must be refused AT PLAN TIME — by the
+# planner itself or by the static analyzer (arroyo_tpu.analysis) that runs
+# at the end of plan_query — never deferred to a runtime blow-up.
+
+FAIL_QUERIES = sorted(
+    os.path.splitext(os.path.basename(p))[0]
+    for p in glob.glob(os.path.join(SMOKE, "queries_bad", "*.sql"))
+    if open(p).readline().startswith("-- reject")
+)
+
+
+@pytest.mark.parametrize("name", FAIL_QUERIES)
+def test_smoke_fail(name, tmp_path):
+    import re
+
+    from arroyo_tpu.sql import plan_query
+    from arroyo_tpu.sql.lexer import SqlError
+
+    path = os.path.join(SMOKE, "queries_bad", f"{name}.sql")
+    with open(path) as f:
+        text = f.read()
+    rule = re.match(r"--\s*reject:\s*(\S+)", text).group(1)
+    sql = text.replace("$input_dir", os.path.join(SMOKE, "inputs")).replace(
+        "$output_path", str(tmp_path / "out.json"))
+    with pytest.raises(SqlError) as ei:
+        plan_query(sql)
+    if rule != "AR000":  # AR000 = rejected by the planner itself
+        assert rule in str(ei.value), (
+            f"{name}: expected rule {rule} in error, got: {ei.value}")
+
+
 @pytest.mark.parametrize("chaining", [False, True], ids=["unchained", "chained"])
 @pytest.mark.parametrize("name", QUERIES)
 def test_smoke(name, chaining, tmp_path, _storage):

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Repo lint + pipeline static analysis — the tools entry point CI uses.
+#
+#   tools/lint.sh              lint the arroyo_tpu package (AST invariant
+#                              checks; see README "Static analysis")
+#   tools/lint.sh --check      additionally `check` every smoke query and
+#                              assert every queries_bad catalog entry still
+#                              produces its annotated diagnostic
+#
+# Exit non-zero on any unwaived lint finding or unexpected check result.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python -m arroyo_tpu lint arroyo_tpu
+
+if [[ "${1:-}" == "--check" ]]; then
+    python - <<'EOF'
+import glob, os, re, sys
+sys.path.insert(0, "tests/smoke")
+import arroyo_tpu
+arroyo_tpu._load_operators()
+import udfs  # noqa: F401 - registers the smoke suite's UDFs/UDAFs
+from arroyo_tpu.analysis import Severity, check_sql
+
+def load(p):
+    sql = open(p).read()
+    return sql.replace("$input_dir", "tests/smoke/inputs").replace(
+        "$output_path", "/tmp/lint_check_out.json")
+
+failed = 0
+for p in sorted(glob.glob("tests/smoke/queries/*.sql")):
+    _pp, diags = check_sql(load(p))
+    errs = [d for d in diags if d.severity == Severity.ERROR]
+    if errs:
+        failed += 1
+        print(f"FAIL {p}: unexpectedly rejected: {[d.rule_id for d in errs]}")
+for p in sorted(glob.glob("tests/smoke/queries_bad/*.sql")):
+    m = re.match(r"--\s*(reject|warn):\s*(\S+)", open(p).read())
+    mode, rule = m.group(1), m.group(2)
+    _pp, diags = check_sql(load(p))
+    errs = {d.rule_id for d in diags if d.severity == Severity.ERROR}
+    ids = {d.rule_id for d in diags}
+    ok = (rule in errs) if mode == "reject" else (not errs and rule in ids)
+    if not ok:
+        failed += 1
+        print(f"FAIL {p}: expected {mode}:{rule}, got {sorted(ids)}")
+print(f"check: {'FAILED' if failed else 'ok'} "
+      f"({len(glob.glob('tests/smoke/queries/*.sql'))} accepted, "
+      f"{len(glob.glob('tests/smoke/queries_bad/*.sql'))} catalog)")
+sys.exit(1 if failed else 0)
+EOF
+fi
