@@ -382,7 +382,7 @@ _DECLARED_FAULT_SITES = (
     "storage.put", "storage.get", "storage.delete", "storage.list",
     "storage.multipart", "network.send", "network.recv", "queue.put",
     "connector.poll", "connector.commit", "worker", "worker.heartbeat",
-    "node.start_worker",
+    "node.start_worker", "controller_rpc", "commit",
 )
 
 

@@ -48,6 +48,7 @@ JOB = {
     "properties": {
         "id": _STR, "pipeline_id": _STR, "state": _STR,
         "restarts": _INT, "checkpoint_epoch": _INT,
+        "n_workers": _INT,  # size of the job's running worker set
     },
 }
 UDF = {

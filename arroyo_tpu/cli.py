@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import queue as _queue_mod
 import signal
 import sys
 import threading
@@ -202,16 +203,40 @@ def _cmd_worker(args) -> int:
         if args.parallelism > 1:
             set_parallelism(pp.graph, args.parallelism)
         graph = pp.graph
+    n_workers = int(getattr(args, "n_workers", None) or 1)
+    network = None
+    assignment = None
+    started = threading.Event()
+    if n_workers > 1:
+        # one worker of a multi-worker set: bind the data plane now (the
+        # port rides the "started" event), hold task startup until the
+        # controller distributes the full peer table
+        from arroyo_tpu.engine.network import NetworkManager
+
+        with open(args.assignment_file) as f:
+            assignment = {(nid, int(sub)): int(w) for nid, sub, w in json.load(f)}
+        network = NetworkManager(host=args.dp_bind or "127.0.0.1")
     eng = Engine(
         graph, job_id=args.job_id,
         restore_epoch=args.restore_epoch,
         storage_url=args.storage_url or None,
+        assignment=assignment,
+        worker_index=int(getattr(args, "worker_index", None) or 0),
+        network=network,
     )
-    eng.start()
-    emit({"event": "started"})
+    if n_workers > 1:
+        emit({"event": "started", "dp_port": network.port,
+              "worker_index": int(args.worker_index or 0)})
+    else:
+        eng.start()
+        started.set()
+        emit({"event": "started"})
     reported: set[int] = set()
+    fatal: list[str] = []
 
     def read_commands() -> None:
+        import traceback as _tb
+
         for line in sys.stdin:
             line = line.strip()
             if not line:
@@ -224,6 +249,25 @@ def _cmd_worker(args) -> int:
                 eng.trigger_checkpoint(int(cmd["epoch"]), then_stop=bool(cmd.get("then_stop")))
             elif cmd.get("cmd") == "stop":
                 eng.stop()
+            elif cmd.get("cmd") == "commit":
+                # phase 2 of the controller's 2PC: the epoch's job-level
+                # metadata is durable across ALL workers
+                eng.deliver_commit(int(cmd["epoch"]))
+            elif cmd.get("cmd") == "peers" and network is not None:
+                network.set_peers({
+                    int(k): (v[0], int(v[1]))
+                    for k, v in (cmd.get("peers") or {}).items()
+                })
+                if not started.is_set():
+                    try:
+                        eng.start()
+                    except Exception:  # noqa: BLE001 - surface as a failed event
+                        # a build/restore error here would otherwise die with
+                        # this thread while the main loop keeps heartbeating —
+                        # an invisible wedge the controller can't diagnose
+                        fatal.append(_tb.format_exc())
+                        return
+                    started.set()
 
     threading.Thread(target=read_commands, daemon=True).start()
     from arroyo_tpu.connectors.preview import take_preview_rows
@@ -231,15 +275,27 @@ def _cmd_worker(args) -> int:
     last_hb = 0.0
     while True:
         with eng._lock:
-            done = len(eng._finished_tasks) + len(eng._failed) >= eng._n_tasks
+            done = (started.is_set() and eng._n_tasks
+                    and len(eng._finished_tasks) + len(eng._failed) >= eng._n_tasks)
             completed = sorted(eng._completed_epochs - reported)
             failed = list(eng._failed)
-        for ep in completed:
-            reported.add(ep)
-            emit({"event": "checkpoint_completed", "epoch": ep})
+        if eng.coordinated:
+            # relay per-subtask acks; the controller declares epochs done
+            while True:
+                try:
+                    emit(eng.coordinator_events.get_nowait())
+                except _queue_mod.Empty:
+                    break
+        else:
+            for ep in completed:
+                reported.add(ep)
+                emit({"event": "checkpoint_completed", "epoch": ep})
         lines = take_preview_rows(args.job_id)
         if lines:
             emit({"event": "sink_data", "lines": lines})
+        if fatal:
+            emit({"event": "failed", "error": fatal[0][-2000:]})
+            return 1
         if failed:
             emit({"event": "failed", "error": failed[0].error or "task failed"})
             return 1
@@ -342,6 +398,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     wp.add_argument("--restore-epoch", type=int, default=None)
     wp.add_argument("--storage-url", default=None)
     wp.add_argument("--udfs-file", default=None)
+    wp.add_argument("--worker-index", type=int, default=None,
+                    help="this worker's index within a multi-worker set")
+    wp.add_argument("--n-workers", type=int, default=1,
+                    help="size of the job's worker set")
+    wp.add_argument("--assignment-file", default=None,
+                    help="JSON [[node_id, subtask, worker], ...] placement")
+    wp.add_argument("--dp-bind", default=None,
+                    help="bind host for the cross-worker data plane")
 
     np_ = sub.add_parser("node", help="per-machine worker launcher daemon")
     np_.add_argument("--controller", required=True,

@@ -48,6 +48,15 @@ _DEFAULTS: dict[str, Any] = {
     "checkpoint": {
         "storage-url": "/tmp/arroyo-tpu/checkpoints",
         "interval-ms": 10_000,
+        # stuck-checkpoint watchdog: a triggered epoch not globally durable
+        # within this window is declared failed, its torn shards subsumed,
+        # and the checkpoint retried; after max-consecutive-failures the
+        # worker set is restored from the last complete checkpoint. 0 = off.
+        "timeout-ms": 600_000,
+        "max-consecutive-failures": 3,
+        # controller-driven GC: compact + drop old checkpoints every N
+        # completed epochs (never past the newest complete one). 0 = off.
+        "compaction": {"epochs": 0},
     },
     "storage": {
         # shared resilience layer (utils/retry.py) for object-store ops
@@ -67,6 +76,9 @@ _DEFAULTS: dict[str, Any] = {
     },
     "controller": {
         "scheduler": "embedded",
+        # size of each job's worker set (start_workers); >1 enables the
+        # controller-owned cross-worker checkpoint coordination
+        "workers-per-job": 1,
     },
     "api": {"http-port": 5115},
     "admin": {"http-port": 5114},

@@ -38,7 +38,14 @@ class SingleFileSource(SourceOperator):
         sub = ctx.task_info.subtask_index
         if sub != 0:
             # only subtask 0 reads the file (reference single_file/source.rs:96)
-            # so the line offset survives restores at any parallelism
+            # so the line offset survives restores at any parallelism.
+            # Restore CLONES subtask 0's offset into this subtask's table
+            # (global tables merge across shards on load); drop the clone
+            # before draining, or our "final" snapshot would persist a stale
+            # copy of the reader's offset that a later restore could merge
+            # OVER the live one — replaying the file from the stale point
+            # while the sink keeps its lines (duplicated output).
+            ctx.table_manager.global_keyed("s").data.clear()
             return SourceFinishType.GRACEFUL
         tbl = ctx.table_manager.global_keyed("s")
         offset = tbl.get(sub, 0)

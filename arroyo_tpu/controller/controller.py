@@ -5,6 +5,15 @@ for jobs (start_updater, lib.rs:543-567) and JobController
 (job_controller/mod.rs:555) driving heartbeat timeout checks, periodic
 checkpoints, failure detection, and the restart budget
 (pipeline.allowed-restarts, healthy-duration resets).
+
+A job runs on a WORKER SET of ``controller.workers-per-job`` workers
+(start_workers; one by default). For multi-worker sets the controller also
+owns cross-worker checkpoint coordination (checkpoint_state.py): per-subtask
+acks flow up from every worker, the epoch goes globally durable here, and
+phase-2 commits fan back out. Any worker of the set dying, missing
+heartbeats, or wedging a checkpoint past ``checkpoint.timeout-ms`` (K
+consecutive times) takes the WHOLE set down and restores it from the last
+globally complete checkpoint.
 """
 
 from __future__ import annotations
@@ -22,7 +31,7 @@ from .states import JobState, check_transition
 
 
 class JobController:
-    """Supervises one job end-to-end (FSM + running-worker control)."""
+    """Supervises one job end-to-end (FSM + running-worker-set control)."""
 
     def __init__(self, db: Database, job_id: str, scheduler: Scheduler,
                  storage_url: Optional[str] = None):
@@ -31,7 +40,13 @@ class JobController:
         self.scheduler = scheduler
         self.storage_url = storage_url or config().get("checkpoint.storage-url")
         self.state = JobState(self.db.get_job(job_id)["state"])
-        self.handle: Optional[WorkerHandle] = None
+        # the job's worker set; a finished worker's slot goes None until the
+        # whole set drains (index == worker_index for assignment/commit fan-out)
+        self.handles: list[Optional[WorkerHandle]] = []
+        self.coordinator = None  # CheckpointCoordinator for multi-worker sets
+        # ordered 2PC trail (metadata_durable / commit_sent ...); survives
+        # worker-set restarts so chaos tests can audit the whole history
+        self.checkpoint_event_log: list[tuple] = []
         self.sql: Optional[str] = None
         self.parallelism = 1
         self.restarts = 0
@@ -42,9 +57,34 @@ class JobController:
         self.stopping_epoch: Optional[int] = None
         self.rescale_to: Optional[int] = None
         self.failure: Optional[str] = None
+        # stuck-checkpoint watchdog: epoch -> trigger time, plus the
+        # consecutive-failure escalation counter and GC cadence counter
+        self._inflight_epochs: dict[int, float] = {}
+        self._ckpt_failures = 0
+        self._epochs_since_gc = 0
+        self._gc_thread: Optional[threading.Thread] = None
+        self._last_stop_resend = 0.0
+        # durable audit counters (survive worker-set restarts; failure
+        # messages get overwritten by later recoveries)
+        self.watchdog_failed_epochs = 0
+        self.watchdog_escalations = 0
         from ..metrics import RateTracker
 
         self.rates = RateTracker(window_s=10.0)
+
+    # -- single-worker compatibility surface ---------------------------
+
+    @property
+    def handle(self) -> Optional[WorkerHandle]:
+        """First live handle (the only one for single-worker jobs)."""
+        for h in self.handles:
+            if h is not None:
+                return h
+        return None
+
+    @handle.setter
+    def handle(self, value: Optional[WorkerHandle]) -> None:
+        self.handles = [] if value is None else [value]
 
     # ------------------------------------------------------------------
 
@@ -66,10 +106,18 @@ class JobController:
             self.failure = traceback.format_exc()
             self._fail(self.failure)
 
+    def _kill_all(self) -> None:
+        for h in self.handles:
+            if h is None:
+                continue
+            try:
+                h.kill()
+            except Exception:  # lint: waive LR102 — best-effort teardown of a worker set; members may already be gone
+                pass
+        self.handles = []
+
     def _fail(self, msg: str) -> None:
-        if self.handle:
-            self.handle.kill()
-            self.handle = None
+        self._kill_all()
         if not self.is_terminal():
             self._set_state(JobState.FAILED, failure_message=msg[-4000:])
 
@@ -183,16 +231,210 @@ class JobController:
             self.sql = pipeline["query"]
             self.parallelism = int(pipeline["parallelism"])
             self.restarts = int(job["restarts"])
-        self.handle = self.scheduler.start_worker(
+        graph_json = self._compile_graph()
+        n_workers = int(config().get("controller.workers-per-job") or 1)
+        self.handles = list(self.scheduler.start_workers(
             self.sql, self.job_id, self.parallelism, self.restore_epoch,
             self.storage_url, udf_specs=self.db.list_udfs(),
-            graph_json=self._compile_graph(),
-        )
+            graph_json=graph_json, n_workers=n_workers,
+        ))
+        self.coordinator = None
+        if len(self.handles) > 1:
+            # multi-worker set: this controller owns checkpoint coordination
+            from .checkpoint_state import CheckpointCoordinator, compute_assignment
+
+            _assignment, expected, _n = compute_assignment(
+                graph_json, len(self.handles))
+            self.coordinator = CheckpointCoordinator(
+                self.job_id, self.storage_url, expected,
+                event_log=self.checkpoint_event_log)
+        # a fresh worker set starts a fresh checkpoint ledger
+        self._inflight_epochs = {}
+        self._ckpt_failures = 0
+        self.db.update_job(self.job_id, n_workers=len(self.handles))
         self.running_since = time.monotonic()
         self.last_checkpoint_time = time.monotonic()
         if self.restore_epoch:
             self.next_epoch = self.restore_epoch + 1
         self._set_state(JobState.RUNNING)
+
+    # ------------------------------------------------- worker-set control
+
+    def _trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
+        """Fan a checkpoint trigger to the whole worker set (each engine
+        injects barriers into ITS local source subtasks) and arm the
+        stuck-epoch watchdog."""
+        if self.coordinator is not None:
+            self.coordinator.begin(epoch)
+        self._inflight_epochs[epoch] = time.monotonic()
+        for h in self.handles:
+            if h is not None:
+                h.trigger_checkpoint(epoch, then_stop=then_stop)
+
+    def _epoch_durable(self, epoch: int) -> None:
+        """An epoch's job-level metadata marker is durable (written by the
+        engine in single-worker mode, by the coordinator at global coverage
+        for worker sets). Record it, then — and only then — fan phase-2
+        commits out (the coordinator's event log proves the ordering;
+        single workers self-commit inside the engine)."""
+        self._inflight_epochs.pop(epoch, None)
+        self._ckpt_failures = 0
+        self.db.record_checkpoint(self.job_id, epoch, "complete")
+        self.db.update_job(self.job_id, checkpoint_epoch=epoch)
+        if self.coordinator is not None:
+            self.coordinator.send_commits(
+                epoch,
+                [h.send_commit if h is not None else None for h in self.handles])
+        if self.state == JobState.CHECKPOINT_STOPPING and epoch == self.stopping_epoch:
+            self._set_state(JobState.STOPPING)
+        self._maybe_gc(epoch)
+
+    def _maybe_gc(self, newest_epoch: int) -> None:
+        """Controller-driven checkpoint GC: every
+        ``checkpoint.compaction.epochs`` completed epochs, compact the
+        newest globally-complete epoch's shards and drop everything older.
+        ``newest_epoch`` is by construction the newest complete one, so the
+        cleanup floor can never delete past a restorable checkpoint (and
+        cleanup_checkpoints keeps the "final" drained-source snapshots).
+        Runs on a background thread — storage-heavy compaction must not
+        stall the supervision tick's heartbeat/watchdog checks for every
+        other job (the reference triggers compaction asynchronously too)."""
+        every = int(config().get("checkpoint.compaction.epochs") or 0)
+        if every <= 0:
+            return
+        self._epochs_since_gc += 1
+        if self._epochs_since_gc < every:
+            return
+        if self._gc_thread is not None and self._gc_thread.is_alive():
+            return  # previous GC still running; counter stays armed
+        self._epochs_since_gc = 0
+
+        def _run_gc() -> None:
+            from ..state.tables import cleanup_checkpoints, compact_job
+
+            try:
+                compact_job(self.storage_url, self.job_id, newest_epoch)
+                cleanup_checkpoints(self.storage_url, self.job_id, newest_epoch)
+                self.db.record_checkpoint(self.job_id, newest_epoch, "compacted")
+            except Exception:  # noqa: BLE001 - GC is best-effort maintenance
+                import logging
+
+                logging.getLogger("arroyo_tpu.controller").exception(
+                    "checkpoint GC failed for %s at epoch %d",
+                    self.job_id, newest_epoch)
+
+        self._gc_thread = threading.Thread(
+            target=_run_gc, daemon=True, name=f"ckpt-gc-{self.job_id}")
+        self._gc_thread.start()
+
+    def _on_worker_finished(self, widx: int, h: WorkerHandle, job: dict) -> bool:
+        """One worker of the set drained. Returns True when the whole set
+        is done and the job-level transition happened."""
+        # release the exited worker's resources (temp sql/udf files,
+        # pipes); for a finished process this is pure cleanup
+        try:
+            h.kill()
+        except Exception:  # lint: waive LR102 — best-effort kill during finished-worker cleanup; process is already gone
+            pass
+        self.handles[widx] = None
+        if any(x is not None for x in self.handles):
+            return False  # the rest of the set is still draining
+        self.handles = []
+        if self.state == JobState.RESCALING:
+            self._finish_rescale(job)
+            return True
+        if self.state in (JobState.STOPPING, JobState.CHECKPOINT_STOPPING):
+            self._set_state(JobState.STOPPED)
+        else:
+            self._set_state(JobState.FINISHING)
+            self._set_state(JobState.FINISHED)
+        return True
+
+    def _on_worker_failed(self, error: str, job: dict) -> None:
+        """Any worker of the set failing (crash, heartbeat loss, wedged
+        checkpoints) takes the WHOLE set down: the survivors hold state the
+        failed worker's subtasks fed, so the only consistent restart is the
+        full set from the last globally complete checkpoint. State-aware:
+        a set dying mid-rescale still rescales, a set dying while stopping
+        just stops (Stopping/CheckpointStopping have no Recovering edge)."""
+        self.failure = error
+        self._kill_all()
+        self.restarts += 1
+        if self.state == JobState.RESCALING:
+            # drain failed mid-rescale: still proceed to the new
+            # parallelism from whatever checkpoint exists
+            self._finish_rescale(job)
+        elif self.state in (JobState.STOPPING, JobState.CHECKPOINT_STOPPING):
+            self._set_state(JobState.STOPPED)
+        else:
+            self._set_state(JobState.RECOVERING,
+                            failure_message=(self.failure or "")[-4000:])
+
+    def _on_stuck_epochs(self, stuck: list[int], job: dict) -> bool:
+        """``checkpoint.timeout-ms`` watchdog: a wedged epoch is declared
+        failed, its torn shards are subsumed (they have no metadata marker,
+        so restore already ignores them — deleting cannot lose state), and
+        the checkpoint is retried at a fresh epoch. After
+        ``checkpoint.max-consecutive-failures`` the whole set is restored
+        from the last globally complete checkpoint. Returns True when the
+        escalation ended this supervision pass."""
+        outstanding: list = []
+        to_subsume: list[int] = []
+        for epoch in stuck:
+            self._inflight_epochs.pop(epoch, None)
+            if self.coordinator is not None:
+                outstanding = self.coordinator.outstanding(epoch) or outstanding
+                # forget FIRST (synchronously): late acks for the epoch are
+                # dropped from here on, so deleting its shards cannot race a
+                # still-completing worker into a torn-but-"complete" epoch
+                self.coordinator.forget(epoch)
+                to_subsume.append(epoch)
+            # single-worker jobs get NO subsume: the engine owns completion
+            # there and has no forget() — deleting shards could race a late-
+            # unwedging subtask whose ack then publishes a metadata marker
+            # over the emptied directory (silent state loss on restore); a
+            # torn epoch without its marker is invisible anyway
+            self.db.record_checkpoint(self.job_id, epoch, "failed")
+            self._ckpt_failures += 1
+            self.watchdog_failed_epochs += 1
+        if to_subsume:
+            # storage deletions off the supervision tick (same reason GC is
+            # backgrounded: the watchdog fires exactly when storage is slow)
+            def _subsume(epochs=tuple(to_subsume)) -> None:
+                from ..state.tables import subsume_torn_epoch
+
+                for e in epochs:
+                    try:
+                        subsume_torn_epoch(self.storage_url, self.job_id, e)
+                    except Exception:  # noqa: BLE001 - orphans stay invisible
+                        import logging
+
+                        logging.getLogger("arroyo_tpu.controller").exception(
+                            "subsume of torn epoch %d failed for %s", e, self.job_id)
+
+            threading.Thread(target=_subsume, daemon=True,
+                             name=f"subsume-{self.job_id}").start()
+        max_fail = int(config().get("checkpoint.max-consecutive-failures") or 3)
+        detail = f" (unacked subtasks: {outstanding})" if outstanding else ""
+        if self._ckpt_failures >= max_fail:
+            self.watchdog_escalations += 1
+            self._on_worker_failed(
+                f"checkpoint wedged {self._ckpt_failures} consecutive times "
+                f"(last epoch {stuck[-1]}){detail}; restoring the worker set "
+                "from the last globally complete checkpoint", job)
+            return True
+        # retry at a FRESH epoch number (the wedged one is subsumed; late
+        # acks for it are dropped by the coordinator)
+        retry = self.next_epoch
+        self.next_epoch += 1
+        then_stop = False
+        if self.stopping_epoch in stuck and self.state in (
+                JobState.CHECKPOINT_STOPPING, JobState.RESCALING):
+            self.stopping_epoch = retry
+            then_stop = True
+        self._trigger_checkpoint(retry, then_stop=then_stop)
+        self.last_checkpoint_time = time.monotonic()
+        return False
 
     def _supervise(self, desired_stop: Optional[str], job: dict) -> None:
         assert self.handle is not None
@@ -204,82 +446,86 @@ class JobController:
             self.restarts = 0
             self.db.update_job(self.job_id, restarts=0)
 
-        for ev in self.handle.poll_events():
-            kind = ev.get("event")
-            if kind == "sink_data":
-                self.db.record_output(self.job_id, ev.get("lines", []))
-            elif kind == "metrics":
-                data = ev.get("data") or {}
-                now = time.monotonic()
-                for op, m in data.items():
-                    self.rates.observe(
-                        f"{op}.sent", int(m.get("arroyo_worker_messages_sent", 0)), now
-                    )
-                    m["messages_per_sec"] = round(self.rates.rate(f"{op}.sent"), 2)
-                if data:
-                    self.db.record_metrics(self.job_id, data)
-            elif kind == "checkpoint_completed":
-                epoch = int(ev["epoch"])
-                self.db.record_checkpoint(self.job_id, epoch, "complete")
-                self.db.update_job(self.job_id, checkpoint_epoch=epoch)
-                if self.state == JobState.CHECKPOINT_STOPPING and epoch == self.stopping_epoch:
-                    self._set_state(JobState.STOPPING)
-            elif kind == "finished":
-                if self.state == JobState.RESCALING:
-                    try:
-                        self.handle.kill()
-                    except Exception:  # lint: waive LR102 — best-effort kill of an already-exited worker; no recovery possible
-                        pass
-                    self.handle = None
-                    self._finish_rescale(job)
+        # liveness snapshot BEFORE draining events: a worker that exits
+        # mid-tick (finished/failed posted right after our poll) must be
+        # diagnosed from its own terminal event on the NEXT tick, not
+        # misreported as a heartbeat loss by the check below
+        alive_before = [h is not None and h.alive() for h in self.handles]
+        for widx, h in enumerate(list(self.handles)):
+            if h is None:
+                continue  # this worker already drained
+            for ev in h.poll_events():
+                kind = ev.get("event")
+                if kind == "sink_data":
+                    self.db.record_output(self.job_id, ev.get("lines", []))
+                elif kind == "metrics":
+                    data = ev.get("data") or {}
+                    now = time.monotonic()
+                    for op, m in data.items():
+                        self.rates.observe(
+                            f"{op}.sent", int(m.get("arroyo_worker_messages_sent", 0)), now
+                        )
+                        m["messages_per_sec"] = round(self.rates.rate(f"{op}.sent"), 2)
+                    if data:
+                        self.db.record_metrics(self.job_id, data)
+                elif kind == "checkpoint_completed":
+                    if self.coordinator is not None:
+                        continue  # coordinated sets: durability is decided HERE
+                    self._epoch_durable(int(ev["epoch"]))
+                elif kind == "subtask_acked" and self.coordinator is not None:
+                    durable = self.coordinator.on_ack(
+                        int(ev["epoch"]), (ev["node"], int(ev["subtask"])))
+                    if durable is not None:
+                        self._epoch_durable(durable)
+                elif kind == "subtask_finished" and self.coordinator is not None:
+                    for e in self.coordinator.on_task_finished(
+                            (ev["node"], int(ev["subtask"]))):
+                        self._epoch_durable(e)
+                elif kind == "finished":
+                    if self._on_worker_finished(widx, h, job):
+                        return
+                    break  # slot emptied; finished is a worker's last event
+                elif kind == "failed":
+                    self._on_worker_failed(
+                        ev.get("error", "unknown worker failure"), job)
                     return
-                if self.state == JobState.STOPPING or self.state == JobState.CHECKPOINT_STOPPING:
-                    self._set_state(JobState.STOPPED)
-                else:
-                    self._set_state(JobState.FINISHING)
-                    self._set_state(JobState.FINISHED)
-                # release the exited worker's resources (temp sql/udf files,
-                # pipes); for a finished process this is pure cleanup
-                try:
-                    self.handle.kill()
-                except Exception:  # lint: waive LR102 — best-effort kill during finished-worker cleanup; process is already gone
-                    pass
-                self.handle = None
-                return
-            elif kind == "failed":
-                self.failure = ev.get("error", "unknown worker failure")
-                self.handle.kill()
-                self.handle = None
-                self.restarts += 1
-                if self.state == JobState.RESCALING:
-                    # drain failed mid-rescale: still proceed to the new
-                    # parallelism from whatever checkpoint exists
-                    self._finish_rescale(job)
-                elif self.state in (JobState.STOPPING, JobState.CHECKPOINT_STOPPING):
-                    self._set_state(JobState.STOPPED)
-                else:
-                    self._set_state(JobState.RECOVERING,
-                                    failure_message=self.failure[-4000:])
+
+        # heartbeat / liveness per worker (reference worker-heartbeat-timeout)
+        hb_timeout = cfgv.get("pipeline.worker-heartbeat-timeout-ms") / 1000
+        for widx, h in enumerate(self.handles):
+            if h is None:
+                continue
+            dead = not (alive_before[widx] if widx < len(alive_before) else True) \
+                and not h.alive()
+            if dead or (
+                time.monotonic() - h.last_heartbeat() > hb_timeout
+            ):
+                self._on_worker_failed(
+                    f"worker {widx} lost (heartbeat timeout)", job)
                 return
 
-        # heartbeat / liveness (reference worker-heartbeat-timeout)
-        hb_timeout = cfgv.get("pipeline.worker-heartbeat-timeout-ms") / 1000
-        if not self.handle.alive() or (
-            time.monotonic() - self.handle.last_heartbeat() > hb_timeout
-        ):
-            self.failure = "worker lost (heartbeat timeout)"
-            self.handle.kill()
-            self.handle = None
-            self.restarts += 1
-            if self.state == JobState.RESCALING:
-                # old worker died draining: rescale from the last checkpoint
-                self._finish_rescale(job)
-            else:
-                self._set_state(JobState.RECOVERING, failure_message=self.failure)
-            return
+        # stuck-checkpoint watchdog (checkpoint.timeout-ms)
+        timeout_ms = cfgv.get("checkpoint.timeout-ms") or 0
+        if timeout_ms and self._inflight_epochs and self.state in (
+                JobState.RUNNING, JobState.CHECKPOINT_STOPPING, JobState.RESCALING):
+            now = time.monotonic()
+            stuck = [e for e, t0 in sorted(self._inflight_epochs.items())
+                     if (now - t0) * 1000 >= timeout_ms]
+            if stuck and self._on_stuck_epochs(stuck, job):
+                return
+
+        # a drop-prone control plane (controller_rpc chaos) may lose the stop
+        # command; stop is idempotent, so re-send it while draining rather
+        # than wedging in Stopping forever
+        if self.state == JobState.STOPPING and (
+                time.monotonic() - self._last_stop_resend >= 1.0):
+            self._last_stop_resend = time.monotonic()
+            for h in self.handles:
+                if h is not None:
+                    h.stop()
 
         # rescale requests from the API (reference states/rescaling.rs:1-70):
-        # checkpoint-and-stop the old worker, then reschedule at the new
+        # checkpoint-and-stop the old worker set, then reschedule at the new
         # parallelism restoring from that final checkpoint
         if self.state == JobState.RUNNING and not desired_stop:
             want = job.get("desired_parallelism")
@@ -287,7 +533,7 @@ class JobController:
                 self.rescale_to = int(want)
                 self.stopping_epoch = self.next_epoch
                 self.next_epoch += 1
-                self.handle.trigger_checkpoint(self.stopping_epoch, then_stop=True)
+                self._trigger_checkpoint(self.stopping_epoch, then_stop=True)
                 self._set_state(JobState.RESCALING)
                 return
             if want and int(want) == self.parallelism:
@@ -300,10 +546,12 @@ class JobController:
             if desired_stop == "checkpoint":
                 self.stopping_epoch = self.next_epoch
                 self.next_epoch += 1
-                self.handle.trigger_checkpoint(self.stopping_epoch, then_stop=True)
+                self._trigger_checkpoint(self.stopping_epoch, then_stop=True)
                 self._set_state(JobState.CHECKPOINT_STOPPING, desired_parallelism=None)
             else:
-                self.handle.stop()
+                for h in self.handles:
+                    if h is not None:
+                        h.stop()
                 self._set_state(JobState.STOPPING, desired_parallelism=None)
             return
 
@@ -311,7 +559,7 @@ class JobController:
         if self.state == JobState.RUNNING:
             interval = cfgv.get("checkpoint.interval-ms") / 1000
             if time.monotonic() - self.last_checkpoint_time >= interval:
-                self.handle.trigger_checkpoint(self.next_epoch)
+                self._trigger_checkpoint(self.next_epoch)
                 self.next_epoch += 1
                 self.last_checkpoint_time = time.monotonic()
 
@@ -369,8 +617,7 @@ class ControllerServer:
         if self._thread:
             self._thread.join(timeout=5)
         for jc in self.jobs.values():
-            if jc.handle:
-                jc.handle.kill()
+            jc._kill_all()
 
     def wait_for_state(self, job_id: str, *states: str, timeout: float = 120) -> str:
         deadline = time.monotonic() + timeout

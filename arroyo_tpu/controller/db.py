@@ -31,6 +31,7 @@ CREATE TABLE IF NOT EXISTS jobs (
     desired_stop TEXT,            -- NULL | 'checkpoint' | 'immediate'
     desired_parallelism INTEGER,  -- non-NULL requests a live rescale
     restarts INTEGER NOT NULL DEFAULT 0,
+    n_workers INTEGER NOT NULL DEFAULT 1,  -- size of the running worker set
     checkpoint_epoch INTEGER NOT NULL DEFAULT 0,
     restore_epoch INTEGER,
     failure_message TEXT,
@@ -102,12 +103,15 @@ class Database:
             self._conn.executescript(_SCHEMA)
             # additive migration for databases created by older builds
             # (CREATE TABLE IF NOT EXISTS leaves existing tables untouched)
-            try:
-                self._conn.execute(
-                    "ALTER TABLE jobs ADD COLUMN desired_parallelism INTEGER")
-            except sqlite3.OperationalError as e:
-                if "duplicate column" not in str(e).lower():
-                    raise  # locked/readonly/corrupt db: fail loudly, not later
+            for migration in (
+                "ALTER TABLE jobs ADD COLUMN desired_parallelism INTEGER",
+                "ALTER TABLE jobs ADD COLUMN n_workers INTEGER NOT NULL DEFAULT 1",
+            ):
+                try:
+                    self._conn.execute(migration)
+                except sqlite3.OperationalError as e:
+                    if "duplicate column" not in str(e).lower():
+                        raise  # locked/readonly/corrupt db: fail loudly, not later
             self._conn.commit()
 
     # ------------------------------------------------------------ pipelines

@@ -144,6 +144,12 @@ class NodeServer:
                 body["sql"], body["job_id"], int(body.get("parallelism", 1)),
                 body.get("restore_epoch"), body.get("storage_url"),
                 body.get("udf_specs"), body.get("graph_json"),
+                # multi-worker set placement: this worker's slice of the
+                # assignment plus its data-plane bind (peers dial in)
+                worker_index=body.get("worker_index"),
+                n_workers=int(body.get("n_workers") or 1),
+                assignment=body.get("assignment"),
+                dp_bind=body.get("dp_bind"),
             )
         except BaseException:
             # spawn failure must release the reservation or the slot is

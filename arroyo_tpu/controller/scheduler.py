@@ -12,13 +12,19 @@ expression objects cross the process boundary (the reference ships protobuf
 physical plans instead — same idea, the plan is data).
 
 Worker wire protocol (process scheduler), JSON lines:
-  worker -> controller (stdout): {"event": "started" | "heartbeat" |
-      "checkpoint_completed", "epoch": N} | {"event": "finished"} |
-      {"event": "failed", "error": "..."}
+  worker -> controller (stdout): {"event": "started", "dp_port": P?} |
+      {"event": "heartbeat"} | {"event": "checkpoint_completed", "epoch": N} |
+      {"event": "subtask_acked", "epoch": N, "node": id, "subtask": S} |
+      {"event": "subtask_finished", "node": id, "subtask": S} |
+      {"event": "finished"} | {"event": "failed", "error": "..."}
   controller -> worker (stdin): {"cmd": "checkpoint", "epoch": N,
-      "then_stop": bool} | {"cmd": "stop"}
+      "then_stop": bool} | {"cmd": "stop"} | {"cmd": "commit", "epoch": N} |
+      {"cmd": "peers", "peers": {"0": [host, port], ...}}
 This plays the role of the reference's ControllerGrpc/WorkerGrpc services
-(proto/rpc.proto:185-202, :397-410).
+(proto/rpc.proto:185-202, :397-410). The subtask_acked/commit/peers legs
+exist for multi-worker jobs (start_workers): workers under an assignment
+relay checkpoint acks to the controller's CheckpointCoordinator and only
+finalize phase 2 on an injected commit (checkpoint_state.py).
 """
 
 from __future__ import annotations
@@ -34,7 +40,7 @@ from typing import Optional
 
 
 class WorkerHandle:
-    """One running execution of a job's dataflow."""
+    """One running worker of a job (a job's dataflow runs on one or more)."""
 
     def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
         raise NotImplementedError
@@ -54,6 +60,11 @@ class WorkerHandle:
     def last_heartbeat(self) -> float:
         raise NotImplementedError
 
+    def send_commit(self, epoch: int) -> None:
+        """Phase-2 commit injection (multi-worker 2PC): only ever called
+        after the epoch's job-level metadata is durable across all workers."""
+        raise NotImplementedError
+
 
 class EmbeddedWorkerHandle(WorkerHandle):
     """Runs the Engine inside the controller process
@@ -61,23 +72,28 @@ class EmbeddedWorkerHandle(WorkerHandle):
 
     def __init__(self, sql: str, job_id: str, parallelism: int,
                  restore_epoch: Optional[int], storage_url: Optional[str] = None,
-                 graph_json: Optional[str] = None):
+                 graph_json: Optional[str] = None, engine=None):
         from ..engine.engine import Engine
 
-        if graph_json is not None:
-            from ..graph import Graph
-
-            graph = Graph.loads(graph_json)  # pre-planned, pre-parallelized IR
+        if engine is not None:
+            # multi-worker set: EmbeddedScheduler.start_workers pre-built the
+            # engine with its assignment/worker_index/network wiring
+            self.engine = engine
         else:
-            from ..sql import plan_query
-            from ..sql.planner import set_parallelism
+            if graph_json is not None:
+                from ..graph import Graph
 
-            pp = plan_query(sql)
-            if parallelism > 1:
-                set_parallelism(pp.graph, parallelism)
-            graph = pp.graph
-        self.engine = Engine(graph, job_id=job_id, restore_epoch=restore_epoch,
-                             storage_url=storage_url)
+                graph = Graph.loads(graph_json)  # pre-planned, pre-parallelized IR
+            else:
+                from ..sql import plan_query
+                from ..sql.planner import set_parallelism
+
+                pp = plan_query(sql)
+                if parallelism > 1:
+                    set_parallelism(pp.graph, parallelism)
+                graph = pp.graph
+            self.engine = Engine(graph, job_id=job_id, restore_epoch=restore_epoch,
+                                 storage_url=storage_url)
         self._events: "queue.Queue[dict]" = queue.Queue()
         self._reported_epochs: set[int] = set()
         self._done = False
@@ -89,7 +105,15 @@ class EmbeddedWorkerHandle(WorkerHandle):
             self._events.put({"event": "started"})
             self.engine.run_to_completion(timeout=None)
             self._emit_epochs()
-            self._events.put({"event": "finished"})
+            if self.engine._aborted:
+                # an externally-killed engine's aborted tasks still drain the
+                # done-accounting; reporting "finished" here would make the
+                # controller wait on the rest of the worker set forever
+                # instead of restoring it
+                self._events.put({"event": "failed",
+                                  "error": "worker aborted (killed)"})
+            else:
+                self._events.put({"event": "finished"})
         except Exception as e:  # noqa: BLE001 - worker failure is data
             self._emit_epochs()
             self._events.put({"event": "failed", "error": str(e)})
@@ -97,9 +121,18 @@ class EmbeddedWorkerHandle(WorkerHandle):
             self._done = True
 
     def _emit_epochs(self) -> None:
-        for ep in sorted(self.engine._completed_epochs - self._reported_epochs):
-            self._reported_epochs.add(ep)
-            self._events.put({"event": "checkpoint_completed", "epoch": ep})
+        if self.engine.coordinated:
+            # multi-worker: relay per-subtask acks upward; the controller's
+            # CheckpointCoordinator (not this worker) declares epochs done
+            while True:
+                try:
+                    self._events.put(self.engine.coordinator_events.get_nowait())
+                except queue.Empty:
+                    break
+        else:
+            for ep in sorted(self.engine._completed_epochs - self._reported_epochs):
+                self._reported_epochs.add(ep)
+                self._events.put({"event": "checkpoint_completed", "epoch": ep})
         from ..connectors.preview import take_preview_rows
 
         lines = take_preview_rows(self.engine.job_id)
@@ -122,6 +155,10 @@ class EmbeddedWorkerHandle(WorkerHandle):
 
     def kill(self) -> None:
         self.engine._abort()
+        if self.engine.network is not None:
+            # multi-worker set teardown / post-finish cleanup: release the
+            # data-plane listener and outgoing connections
+            self.engine.network.close()
 
     def poll_events(self) -> list[dict]:
         self._emit_epochs()
@@ -136,7 +173,15 @@ class EmbeddedWorkerHandle(WorkerHandle):
         return not self._done
 
     def last_heartbeat(self) -> float:
-        return time.monotonic()  # in-process: liveness == thread state
+        # actual engine progress, not mere thread existence: a wedged
+        # in-process engine (task hung in an operator or a stalled storage
+        # call) must still trip the controller's heartbeat timeout
+        if self._done:
+            return time.monotonic()  # exit/failure is reported via events
+        return self.engine.heartbeat()
+
+    def send_commit(self, epoch: int) -> None:
+        self.engine.deliver_commit(epoch)
 
 
 class ProcessWorkerHandle(WorkerHandle):
@@ -145,7 +190,9 @@ class ProcessWorkerHandle(WorkerHandle):
 
     def __init__(self, sql: str, job_id: str, parallelism: int,
                  restore_epoch: Optional[int], storage_url: Optional[str] = None,
-                 udf_specs: Optional[list] = None, graph_json: Optional[str] = None):
+                 udf_specs: Optional[list] = None, graph_json: Optional[str] = None,
+                 worker_index: Optional[int] = None, n_workers: int = 1,
+                 assignment: Optional[list] = None, dp_bind: Optional[str] = None):
         import tempfile
 
         # the planned IR ships as data when serializable (reference:
@@ -170,6 +217,20 @@ class ProcessWorkerHandle(WorkerHandle):
             cmd += ["--restore-epoch", str(restore_epoch)]
         if storage_url:
             cmd += ["--storage-url", storage_url]
+        self._assignment_file: Optional[str] = None
+        if n_workers > 1:
+            # assignment ships as a temp file: [[node_id, subtask, worker]...]
+            af = tempfile.NamedTemporaryFile(
+                "w", suffix=".json", prefix=f"{job_id}-assign-", delete=False
+            )
+            json.dump(assignment or [], af)
+            af.close()
+            self._assignment_file = af.name
+            cmd += ["--worker-index", str(worker_index or 0),
+                    "--n-workers", str(n_workers),
+                    "--assignment-file", af.name]
+            if dp_bind:
+                cmd += ["--dp-bind", dp_bind]
         self._udfs_file: Optional[str] = None
         if udf_specs:
             uf = tempfile.NamedTemporaryFile(
@@ -185,6 +246,8 @@ class ProcessWorkerHandle(WorkerHandle):
         )
         self._events: "queue.Queue[dict]" = queue.Queue()
         self._hb = time.monotonic()
+        self.dp_port: Optional[int] = None  # data-plane port (multi-worker)
+        self._started = threading.Event()
         self._reader = threading.Thread(target=self._read_stdout, daemon=True)
         self._reader.start()
 
@@ -199,12 +262,23 @@ class ProcessWorkerHandle(WorkerHandle):
             except json.JSONDecodeError:
                 continue  # worker debug output
             self._hb = time.monotonic()
+            if ev.get("event") == "started":
+                if ev.get("dp_port") is not None:
+                    self.dp_port = int(ev["dp_port"])
+                self._started.set()
             if ev.get("event") != "heartbeat":
                 self._events.put(ev)
         rc = self.proc.wait()
+        self._started.set()  # unblock wait_dp_port on a crashed spawn
         if rc != 0:
             err = self.proc.stderr.read() if self.proc.stderr else ""
             self._events.put({"event": "failed", "error": f"worker exited {rc}: {err[-2000:]}"})
+
+    def wait_dp_port(self, timeout: float = 60.0) -> Optional[int]:
+        """Block until the worker reported its data-plane port (multi-worker
+        peer exchange); None if it died or never reported."""
+        self._started.wait(timeout)
+        return self.dp_port
 
     def _send(self, obj: dict) -> None:
         if self.proc.stdin and self.proc.poll() is None:
@@ -220,10 +294,17 @@ class ProcessWorkerHandle(WorkerHandle):
     def stop(self) -> None:
         self._send({"cmd": "stop"})
 
+    def send_commit(self, epoch: int) -> None:
+        self._send({"cmd": "commit", "epoch": epoch})
+
+    def send_peers(self, peers: dict[int, tuple[str, int]]) -> None:
+        self._send({"cmd": "peers",
+                    "peers": {str(k): list(v) for k, v in peers.items()}})
+
     def kill(self) -> None:
         if self.proc.poll() is None:
             self.proc.kill()
-        for path in (self._sql_file.name, self._udfs_file):
+        for path in (self._sql_file.name, self._udfs_file, self._assignment_file):
             if path:
                 try:
                     os.unlink(path)
@@ -255,6 +336,21 @@ class Scheduler:
                      graph_json: Optional[str] = None) -> WorkerHandle:
         raise NotImplementedError
 
+    def start_workers(self, sql: str, job_id: str, parallelism: int,
+                      restore_epoch: Optional[int],
+                      storage_url: Optional[str] = None,
+                      udf_specs: Optional[list] = None,
+                      graph_json: Optional[str] = None,
+                      n_workers: int = 1) -> list[WorkerHandle]:
+        """Launch the job's worker set. The default keeps one worker per
+        job (the kubernetes scheduler's current shape: one pod holds the
+        whole dataflow); Embedded/Process/Node override with real
+        multi-worker placement under a computed subtask assignment.
+        Multi-worker needs the pre-planned IR; without graph_json the set
+        degrades to a single worker rather than re-planning per worker."""
+        return [self.start_worker(sql, job_id, parallelism, restore_epoch,
+                                  storage_url, udf_specs, graph_json)]
+
 
 class EmbeddedScheduler(Scheduler):
     def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
@@ -266,12 +362,81 @@ class EmbeddedScheduler(Scheduler):
         return EmbeddedWorkerHandle(sql, job_id, parallelism, restore_epoch, storage_url,
                                     graph_json)
 
+    def start_workers(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
+                      udf_specs=None, graph_json=None, n_workers=1):
+        if n_workers <= 1 or graph_json is None:
+            return [self.start_worker(sql, job_id, parallelism, restore_epoch,
+                                      storage_url, udf_specs, graph_json)]
+        from ..engine.engine import Engine
+        from ..engine.network import NetworkManager
+        from ..graph import Graph
+        from .checkpoint_state import compute_assignment
+
+        if udf_specs:
+            from ..compiler import activate_udf_specs
+
+            activate_udf_specs(udf_specs)
+        assignment, _expected, n = compute_assignment(graph_json, n_workers)
+        if n <= 1:
+            return [self.start_worker(sql, job_id, parallelism, restore_epoch,
+                                      storage_url, udf_specs, graph_json)]
+        # ports are known at NetworkManager construction, so peers can be
+        # wired before any engine starts sending
+        managers = [NetworkManager() for _ in range(n)]
+        peers = {i: ("127.0.0.1", m.port) for i, m in enumerate(managers)}
+        handles = []
+        for i, m in enumerate(managers):
+            m.set_peers(peers)
+            eng = Engine(Graph.loads(graph_json), job_id=job_id,
+                         restore_epoch=restore_epoch, storage_url=storage_url,
+                         assignment=assignment, worker_index=i, network=m)
+            handles.append(EmbeddedWorkerHandle(
+                sql, job_id, parallelism, restore_epoch, storage_url,
+                engine=eng))
+        return handles
+
 
 class ProcessScheduler(Scheduler):
     def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
                      udf_specs=None, graph_json=None):
         return ProcessWorkerHandle(sql, job_id, parallelism, restore_epoch, storage_url,
                                    udf_specs, graph_json)
+
+    def start_workers(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
+                      udf_specs=None, graph_json=None, n_workers=1):
+        if n_workers <= 1 or graph_json is None:
+            return [self.start_worker(sql, job_id, parallelism, restore_epoch,
+                                      storage_url, udf_specs, graph_json)]
+        from .checkpoint_state import compute_assignment
+
+        assignment, _expected, n = compute_assignment(graph_json, n_workers)
+        if n <= 1:
+            return [self.start_worker(sql, job_id, parallelism, restore_epoch,
+                                      storage_url, udf_specs, graph_json)]
+        assign_json = [[nid, sub, w] for (nid, sub), w in sorted(assignment.items())]
+        handles = [
+            ProcessWorkerHandle(sql, job_id, parallelism, restore_epoch,
+                                storage_url, udf_specs, graph_json,
+                                worker_index=i, n_workers=n,
+                                assignment=assign_json, dp_bind="127.0.0.1")
+            for i in range(n)
+        ]
+        # peer exchange: every worker binds its data plane and reports the
+        # port in its "started" event; engines only start once all peers
+        # are known (the worker holds task startup until the peers cmd)
+        peers: dict[int, tuple[str, int]] = {}
+        for i, h in enumerate(handles):
+            port = h.wait_dp_port(timeout=90.0)
+            if port is None:
+                for hh in handles:
+                    hh.kill()
+                raise RuntimeError(
+                    f"worker {i}/{n} of job {job_id} never reported its "
+                    "data-plane port (died during startup?)")
+            peers[i] = ("127.0.0.1", port)
+        for h in handles:
+            h.send_peers(peers)
+        return handles
 
 
 class NodeWorkerHandle(WorkerHandle):
@@ -280,33 +445,63 @@ class NodeWorkerHandle(WorkerHandle):
     over the node's HTTP surface; events and liveness are polled."""
 
     def __init__(self, node_addr: str, sql: str, job_id: str, parallelism: int,
-                 restore_epoch, storage_url, udf_specs, graph_json=None):
+                 restore_epoch, storage_url, udf_specs, graph_json=None,
+                 worker_index=None, n_workers=1, assignment=None, dp_bind=None):
         from .node import _get, _post
 
         self._get, self._post = _get, _post
         self.node_addr = node_addr.rstrip("/")
-        r = _post(f"{self.node_addr}/start_worker", {
+        body = {
             "sql": sql, "job_id": job_id, "parallelism": parallelism,
             "restore_epoch": restore_epoch, "storage_url": storage_url,
             "udf_specs": udf_specs, "graph_json": graph_json,
-        })
+        }
+        if n_workers > 1:
+            body.update({"worker_index": worker_index, "n_workers": n_workers,
+                         "assignment": assignment,
+                         # bind all interfaces: data-plane peers dial in
+                         # from other machines of the cluster
+                         "dp_bind": dp_bind or "0.0.0.0"})
+        r = _post(f"{self.node_addr}/start_worker", body)
         self.worker_id = r["worker_id"]
         self._alive = True
         self._hb = time.monotonic()
         self._buffer: list[dict] = []
+        self.dp_port: Optional[int] = None
+
+    def _command(self, path: str, body: dict) -> None:
+        """Controller -> node-daemon command with the controller_rpc chaos
+        site (drop/dup/delay model a flaky control network; a dropped
+        command is recovered by protocol-level retries — the stuck-epoch
+        watchdog re-triggers, commits re-deliver cumulatively — never by
+        pretending it arrived)."""
+        from ..faults import fault_point
+
+        verdict = fault_point("controller_rpc", key=path, op="post")
+        if verdict is not None and verdict[0] == "drop":
+            return
+        try:
+            self._post(f"{self.node_addr}{path}", body)
+            if verdict is not None and verdict[0] == "dup":
+                self._post(f"{self.node_addr}{path}", body)
+        except OSError:
+            pass
 
     def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
-        try:
-            self._post(f"{self.node_addr}/workers/{self.worker_id}/send",
-                       {"cmd": "checkpoint", "epoch": epoch, "then_stop": then_stop})
-        except OSError:
-            pass
+        self._command(f"/workers/{self.worker_id}/send",
+                      {"cmd": "checkpoint", "epoch": epoch, "then_stop": then_stop})
 
     def stop(self) -> None:
-        try:
-            self._post(f"{self.node_addr}/workers/{self.worker_id}/stop", {})
-        except OSError:
-            pass
+        self._command(f"/workers/{self.worker_id}/stop", {})
+
+    def send_commit(self, epoch: int) -> None:
+        self._command(f"/workers/{self.worker_id}/send",
+                      {"cmd": "commit", "epoch": epoch})
+
+    def send_peers(self, peers: dict[int, tuple[str, int]]) -> None:
+        self._command(f"/workers/{self.worker_id}/send",
+                      {"cmd": "peers",
+                       "peers": {str(k): list(v) for k, v in peers.items()}})
 
     def kill(self) -> None:
         try:
@@ -316,17 +511,52 @@ class NodeWorkerHandle(WorkerHandle):
         self._alive = False
 
     def poll_events(self) -> list[dict]:
+        from ..faults import fault_point
+
+        out, self._buffer = self._buffer, []
+        verdict = fault_point("controller_rpc",
+                              key=f"/workers/{self.worker_id}/events", op="get")
+        if verdict is not None and verdict[0] == "drop":
+            # a dropped poll loses nothing: the daemon only drains its
+            # buffer when a poll actually arrives, so the next one catches up
+            return out
         try:
             r = self._get(f"{self.node_addr}/workers/{self.worker_id}/events")
         except OSError:
             # node unreachable: let the heartbeat timeout declare death
-            return []
+            return out
         # anchor to the WORKER's own heartbeat (relayed as an age so clocks
         # need not agree): a hung worker must still trip the controller's
         # heartbeat timeout even though the node daemon answers polls
         self._hb = time.monotonic() - float(r.get("hb_age_s", 0.0))
         self._alive = bool(r["alive"]) or bool(r["events"])
-        return r["events"]
+        for ev in r["events"]:
+            if ev.get("event") == "started" and ev.get("dp_port") is not None:
+                self.dp_port = int(ev["dp_port"])
+        return out + r["events"]
+
+    def wait_dp_port(self, timeout: float = 90.0) -> Optional[int]:
+        """Poll the node daemon until the worker reports its data-plane
+        port; events seen along the way are buffered for poll_events."""
+        deadline = time.monotonic() + timeout
+        while self.dp_port is None and time.monotonic() < deadline:
+            try:
+                r = self._get(f"{self.node_addr}/workers/{self.worker_id}/events")
+            except OSError:
+                r = None  # daemon briefly unreachable; re-poll below
+            if r is None:
+                time.sleep(0.2)
+                continue
+            self._hb = time.monotonic() - float(r.get("hb_age_s", 0.0))
+            for ev in r["events"]:
+                if ev.get("event") == "started" and ev.get("dp_port") is not None:
+                    self.dp_port = int(ev["dp_port"])
+                self._buffer.append(ev)
+            if not r["alive"] and not r["events"]:
+                return None
+            if self.dp_port is None:
+                time.sleep(0.1)
+        return self.dp_port
 
     def alive(self) -> bool:
         return self._alive
@@ -377,6 +607,12 @@ class LazyNodeWorkerHandle(WorkerHandle):
         else:
             self._inner.stop()
 
+    def send_commit(self, epoch: int) -> None:
+        if self._inner is None:
+            self._queued.append(("send_commit", epoch))
+        else:
+            self._inner.send_commit(epoch)
+
     def kill(self) -> None:
         self._dead = True
         if self._inner is not None:
@@ -406,7 +642,7 @@ class NodeScheduler(Scheduler):
     def __init__(self, db):
         self.db = db
 
-    def _place_once(self, args: tuple, last: str):
+    def _place_once(self, args: tuple, last: str, **multi_kw):
         """One placement sweep over live daemons -> (handle|None, reason)."""
         import urllib.error
 
@@ -425,7 +661,7 @@ class NodeScheduler(Scheduler):
         candidates.sort(key=lambda fn: -fn[0])
         for _free, n in candidates:
             try:
-                return NodeWorkerHandle(n["addr"], *args), last
+                return NodeWorkerHandle(n["addr"], *args, **multi_kw), last
             except urllib.error.HTTPError as e:
                 last = f"node {n['id']} rejected placement: {e}"
             except OSError as e:
@@ -447,6 +683,61 @@ class NodeScheduler(Scheduler):
         lazy = LazyNodeWorkerHandle(self, args, placement_timeout_s)
         lazy._last = last
         return lazy
+
+    def start_workers(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
+                      udf_specs=None, graph_json=None, n_workers=1,
+                      placement_timeout_s: float = 30.0):
+        if n_workers <= 1 or graph_json is None:
+            return [self.start_worker(sql, job_id, parallelism, restore_epoch,
+                                      storage_url, udf_specs, graph_json,
+                                      placement_timeout_s)]
+        from urllib.parse import urlparse
+
+        from .checkpoint_state import compute_assignment
+
+        assignment, _expected, n = compute_assignment(graph_json, n_workers)
+        if n <= 1:
+            return [self.start_worker(sql, job_id, parallelism, restore_epoch,
+                                      storage_url, udf_specs, graph_json,
+                                      placement_timeout_s)]
+        assign_json = [[nid, sub, w] for (nid, sub), w in sorted(assignment.items())]
+        # worker-set placement is all-or-nothing and synchronous: the data
+        # plane needs every peer's (host, port) before any engine may run,
+        # so lazy placement cannot apply here. A partially placed set is
+        # torn down rather than left half-running.
+        handles: list[NodeWorkerHandle] = []
+        deadline = time.monotonic() + placement_timeout_s
+        last = "no live node daemons registered"
+        try:
+            for i in range(n):
+                args = (sql, job_id, parallelism, restore_epoch, storage_url,
+                        udf_specs, graph_json)
+                while True:
+                    h, last = self._place_once(
+                        args, last, worker_index=i, n_workers=n,
+                        assignment=assign_json)
+                    if h is not None:
+                        handles.append(h)
+                        break
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"placed {i}/{n} workers of job {job_id}: {last}")
+                    time.sleep(0.25)
+            peers: dict[int, tuple[str, int]] = {}
+            for i, h in enumerate(handles):
+                port = h.wait_dp_port(timeout=90.0)
+                if port is None:
+                    raise RuntimeError(
+                        f"worker {i}/{n} of job {job_id} never reported its "
+                        "data-plane port")
+                peers[i] = (urlparse(h.node_addr).hostname or "127.0.0.1", port)
+            for h in handles:
+                h.send_peers(peers)
+        except Exception:
+            for h in handles:
+                h.kill()
+            raise
+        return handles
 
 
 def scheduler_for(name: str, db=None) -> Scheduler:

@@ -8,10 +8,15 @@ process; the multi-host data plane arrives with the C++/DCN runtime, while
 keyed exchange inside a TPU slice is lowered separately (arroyo_tpu.parallel).
 
 The engine also plays the reference controller's checkpoint-coordination role
-for embedded runs (job_controller/mod.rs:325 start_checkpoint,
+for SINGLE-worker runs (job_controller/mod.rs:325 start_checkpoint,
 checkpoint_state.rs): it injects ControlMessage::Checkpoint into source tasks,
 collects per-subtask checkpoint metadata, and writes the job-level metadata
-marker once every subtask reports.
+marker once every subtask reports. Under an ``assignment`` (multi-worker
+mode) the engine is a pure participant: it relays per-subtask acks upward
+through ``coordinator_events`` and accepts externally-injected commits via
+``deliver_commit`` — epoch completion is owned by the control plane's
+CheckpointCoordinator (controller/checkpoint_state.py), so no worker can
+finalize phase 2 against an epoch another worker never made durable.
 """
 
 from __future__ import annotations
@@ -19,6 +24,7 @@ from __future__ import annotations
 import queue as _queue
 import threading
 import time
+from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ..batch import Schema
@@ -54,6 +60,26 @@ def construct_operator(op: OpName, cfg: dict):
     if op not in _CONSTRUCTORS:
         raise ValueError(f"no constructor registered for operator {op}")
     return _CONSTRUCTORS[op](cfg)
+
+
+@dataclass(frozen=True)
+class CheckpointWait:
+    """Outcome of Engine.checkpoint_and_wait. Truthy only when the epoch
+    actually completed, so ``assert eng.checkpoint_and_wait(...)`` keeps
+    working — but callers can now tell a drained pipeline ("finished") from
+    a stuck barrier ("timeout", with the subtasks that never acked)."""
+
+    outcome: str  # "completed" | "finished" | "timeout"
+    missing: tuple = ()  # (node_id, subtask) pairs unacked at timeout
+
+    def __bool__(self) -> bool:
+        return self.outcome == "completed"
+
+    def __repr__(self) -> str:
+        if self.outcome == "timeout" and self.missing:
+            return (f"CheckpointWait(timeout, never acked: "
+                    f"{list(self.missing)})")
+        return f"CheckpointWait({self.outcome})"
 
 
 class Engine:
@@ -98,6 +124,12 @@ class Engine:
         self.assignment = assignment
         self.worker_index = worker_index
         self.network = network
+        # multi-worker mode: epoch completion is controller-owned; this
+        # engine only relays acks up and accepts injected commits
+        self.coordinated = assignment is not None
+        self.coordinator_events: "_queue.Queue[dict]" = _queue.Queue()
+        self._committed_through = restore_epoch or 0
+        self.delivered_commits: list[int] = []
         # stable numeric node ids for Quad addressing
         self._node_index = {nid: i for i, nid in enumerate(sorted(graph.nodes))}
         self.resp_queue: "_queue.Queue[ControlResp]" = _queue.Queue()
@@ -106,12 +138,27 @@ class Engine:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._finished_tasks: set[tuple[str, int]] = set()
+        # the subset that drained CLEANLY (graceful EOF / checkpoint-then-
+        # stop): only these have final/durable state and may stand in for
+        # epoch coverage; stop/abort exits must not, or an epoch could go
+        # "complete" with a subtask's snapshot missing and a restore would
+        # replay its source from zero
+        self._clean_finished: set[tuple[str, int]] = set()
         self._failed: list[ControlResp] = []
         self._checkpoints: dict[int, dict[tuple[str, int], dict]] = {}
         self._completed_epochs: set[int] = set()
         self._resp_thread: Optional[threading.Thread] = None
         self._n_tasks = 0
         self.restored_watermark: Optional[int] = None
+        # triggers that arrived before build() populated the source tasks —
+        # replayed by start(); without this, a checkpoint trigger racing a
+        # slow build (cold compile, big restore) is silently LOST and the
+        # epoch wedges from birth
+        self._running = False
+        self._pending_triggers: list[tuple[int, bool]] = []
+        # set by _abort(): distinguishes a torn-down engine from a drained
+        # one — an externally-killed worker must not report "finished"
+        self._aborted = False
 
     # -------------------------------------------------------------- building
 
@@ -258,6 +305,11 @@ class Engine:
                 task = self.tasks.get((node.node_id, s))
                 if task is not None:  # remote subtasks belong to other workers
                     task.start()
+        with self._lock:
+            self._running = True
+            pending, self._pending_triggers = self._pending_triggers, []
+        for epoch, then_stop in pending:
+            self.trigger_checkpoint(epoch, then_stop=then_stop)
 
     def _collect_resps(self) -> None:
         while True:
@@ -272,6 +324,15 @@ class Engine:
                 key = (resp.node_id, resp.subtask_index)
                 if resp.kind == "task_finished":
                     self._finished_tasks.add(key)
+                    if resp.clean:
+                        self._clean_finished.add(key)
+                        if self.coordinated:
+                            # only CLEAN drains are relayed as coverage;
+                            # stop/abort exits have no durable final state
+                            self.coordinator_events.put({
+                                "event": "subtask_finished",
+                                "node": key[0], "subtask": key[1],
+                            })
                     self._finish_ready_epochs()
                 elif resp.kind == "task_failed":
                     self._failed.append(resp)
@@ -283,6 +344,11 @@ class Engine:
                 elif resp.kind == "checkpoint_completed":
                     ep = self._checkpoints.setdefault(resp.epoch, {})
                     ep[key] = resp.subtask_metadata
+                    if self.coordinated:
+                        self.coordinator_events.put({
+                            "event": "subtask_acked", "epoch": resp.epoch,
+                            "node": key[0], "subtask": key[1],
+                        })
                     self._finish_ready_epochs()
                 self._cond.notify_all()
 
@@ -290,11 +356,20 @@ class Engine:
         """An epoch is complete once every task has snapshotted it or
         finished outright (a drained source can't take part in a barrier —
         its state is final; reference CheckpointState handles TaskFinished
-        the same way). Caller holds the lock."""
+        the same way). Caller holds the lock.
+
+        Only the single-worker engine decides this locally. In assignment
+        mode the per-subtask acks were already relayed upward (above): the
+        controller's CheckpointCoordinator owns global coverage, writes the
+        job-level metadata marker, and injects commits via deliver_commit —
+        a local task count can never prematurely finalize an epoch that
+        other workers are still snapshotting."""
+        if self.coordinated:
+            return
         for epoch, ep in self._checkpoints.items():
             if epoch in self._completed_epochs or not ep:
                 continue
-            covered = set(ep) | self._finished_tasks
+            covered = set(ep) | self._clean_finished
             if len(covered) >= self._n_tasks:
                 write_job_checkpoint_metadata(
                     self.storage_url, self.job_id, epoch,
@@ -304,14 +379,6 @@ class Engine:
                 # two-phase commit: metadata is durable, tell committing
                 # sinks to finalize (reference send_commit_messages,
                 # job_controller/mod.rs:838)
-                # KNOWN LIMIT (multi-worker embedded mode only): _n_tasks
-                # counts LOCAL tasks, so with an assignment this fires when
-                # this worker's subtasks finish the epoch — remote workers
-                # may still be snapshotting. Distributed runs need the
-                # controller to own epoch completion (cross-worker
-                # CheckpointState); until then committing sources/sinks in
-                # assignment mode can finalize against a not-yet-global
-                # epoch.
                 for key, task in self.tasks.items():
                     if key in self._finished_tasks:
                         continue
@@ -321,20 +388,87 @@ class Engine:
                             ControlMessage(kind="commit", epoch=epoch)
                         )
 
+    def deliver_commit(self, epoch: int) -> None:
+        """Phase-2 entry point in assignment mode: the control plane calls
+        this once ``epoch``'s job-level metadata is durable across ALL
+        workers. Marks the epoch (and any earlier ones whose commit message
+        was lost — chaos site ``commit`` drops them on purpose) complete and
+        forwards per-epoch commit messages to local committing operators, in
+        epoch order. Cumulative delivery is what makes a dropped phase-2
+        message re-delivered on the next epoch instead of lost."""
+        to_commit: list[tuple[Task, int]] = []
+        with self._lock:
+            if epoch <= self._committed_through:
+                return
+            lo = self._committed_through
+            self._committed_through = epoch
+            # the carried epoch is durable by the coordinator's ordering
+            # invariant; intermediates are marked only if this worker acked
+            # them — an epoch the watchdog subsumed (and nobody acked here)
+            # must not surface as "completed" to compact()/cleanup() callers
+            self._completed_epochs.add(epoch)
+            for e in sorted(self._checkpoints):
+                if not (lo < e <= epoch):
+                    continue
+                self._completed_epochs.add(e)
+                self.delivered_commits.append(e)
+                for key, task in self.tasks.items():
+                    if key not in self._checkpoints[e] or key in self._finished_tasks:
+                        continue
+                    opv = getattr(task, "operator", None)
+                    if opv is not None and getattr(opv, "is_committing", lambda: False)():
+                        to_commit.append((task, e))
+            self._cond.notify_all()
+        for task, e in to_commit:
+            task.control_queue.put(ControlMessage(kind="commit", epoch=e))
+
+    def heartbeat(self) -> float:
+        """Liveness derived from actual engine progress: the stalest
+        still-running task's last run-loop beat (tasks beat every loop
+        iteration, sources via poll_control, backpressured producers from
+        the inbox wait loop). A wedged subtask — hung in an operator or a
+        stalled storage call — stops beating and ages this value out, which
+        is what lets the controller's heartbeat timeout catch a hung
+        embedded engine (a thread's mere existence proves nothing). The
+        flip side: one process_batch call is one beat interval, so
+        ``pipeline.worker-heartbeat-timeout-ms`` must stay above the
+        worst-case single-batch latency (the 30s default leaves plenty of
+        headroom for cold jit compiles and retry backoff)."""
+        beats = []
+        with self._lock:
+            for key, t in self.tasks.items():
+                if key in self._finished_tasks:
+                    continue
+                if t.thread is not None and t.thread.is_alive():
+                    beats.append(t.last_progress)
+        return min(beats) if beats else time.monotonic()
+
     # -------------------------------------------------------------- control
 
     def source_tasks(self) -> list[Task]:
         return [t for t in self.tasks.values() if t.is_source]
 
     def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:
-        """Reference job_controller/mod.rs:325: checkpoint starts at sources."""
+        """Reference job_controller/mod.rs:325: checkpoint starts at sources.
+        Triggers arriving before the engine is running are buffered and
+        replayed by start() — never dropped."""
+        with self._lock:
+            if not self._running:
+                self._pending_triggers.append((epoch, then_stop))
+                return
         barrier = CheckpointBarrier(epoch=epoch, timestamp=int(time.time() * 1e6), then_stop=then_stop)
         for t in self.source_tasks():
             t.control_queue.put(ControlMessage(kind="checkpoint", barrier=barrier))
 
-    def checkpoint_and_wait(self, epoch: int, timeout: float = 60.0, then_stop: bool = False) -> bool:
-        """True once every subtask snapshotted ``epoch``; False if the
-        pipeline finished first (sources already drained) or on timeout."""
+    def checkpoint_and_wait(self, epoch: int, timeout: float = 60.0,
+                            then_stop: bool = False) -> CheckpointWait:
+        """Trigger ``epoch`` and wait. Returns a CheckpointWait whose
+        outcome distinguishes the three exits callers used to have to
+        guess apart: "completed" (truthy — every subtask snapshotted; in
+        assignment mode, globally durable and committed), "finished" (the
+        pipeline drained before the barrier — a stop, not a failure), and
+        "timeout" (a stuck barrier, with the subtasks that never acked in
+        ``missing`` for the diagnostic)."""
         self.trigger_checkpoint(epoch, then_stop=then_stop)
         deadline = time.monotonic() + timeout
         with self._lock:
@@ -342,12 +476,15 @@ class Engine:
                 if self._failed:
                     raise RuntimeError(f"task failed during checkpoint: {self._failed[0].error}")
                 if len(self._finished_tasks) >= self._n_tasks:
-                    return False
+                    return CheckpointWait("finished")
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return False
+                    acked = set(self._checkpoints.get(epoch, ()))
+                    missing = tuple(sorted(
+                        set(self.tasks) - acked - self._finished_tasks))
+                    return CheckpointWait("timeout", missing)
                 self._cond.wait(timeout=min(remaining, 0.5))
-        return True
+        return CheckpointWait("completed")
 
     def compact(self, epoch: int) -> int:
         """Merge the epoch's per-subtask state shards (reference: controller
@@ -379,6 +516,7 @@ class Engine:
     def _abort(self) -> None:
         """Hard-stop after a task failure: stop sources and close every
         inbox so blocked producers/consumers exit."""
+        self._aborted = True
         self.stop()
         for inbox in self._inboxes.values():
             inbox.close()

@@ -72,6 +72,9 @@ class SourceContext:
         self.ctx = task.ctx
 
     def poll_control(self) -> Optional[ControlMessage]:
+        # connector run loops poll between batches, so this doubles as the
+        # source-task liveness beat (Engine.heartbeat)
+        self._task.last_progress = time.monotonic()
         try:
             return self._task.control_queue.get_nowait()
         except _queue.Empty:
@@ -102,6 +105,13 @@ class Task:
         self.control_queue: "_queue.Queue[ControlMessage]" = _queue.Queue()
         self.thread: Optional[threading.Thread] = None
         self.is_source = isinstance(operator, SourceOperator)
+        # liveness beat: updated every run-loop iteration / control poll /
+        # backpressure wait; a hung task stops beating (Engine.heartbeat)
+        self.last_progress = time.monotonic()
+        # True when the run loop drained cleanly (graceful EOF or
+        # checkpoint-then-stop): only such finishes carry final/durable
+        # state and may stand in for epoch coverage (ControlResp.clean)
+        self.finished_clean = True
         from ..metrics import registry as _metrics_registry
 
         self.metrics = _metrics_registry.task(
@@ -132,14 +142,20 @@ class Task:
 
     # ------------------------------------------------------------- run loops
 
+    def _beat(self) -> None:
+        self.last_progress = time.monotonic()
+
     def _run_guarded(self) -> None:
         try:
+            # a producer blocked on a full inbox is backpressured, not hung:
+            # the inbox's budget wait loop beats through this thread hook
+            threading.current_thread().arroyo_beat = self._beat  # type: ignore[attr-defined]
             self._resp("task_started")
             if self.is_source:
                 self._run_source()
             else:
                 self._run_operator()
-            self._resp("task_finished")
+            self._resp("task_finished", clean=self.finished_clean)
         except Exception:
             self._resp("task_failed", error=traceback.format_exc())
 
@@ -156,6 +172,9 @@ class Task:
             self.ctx.table_manager.checkpoint("final", self.ctx.watermark())
             self.collector.broadcast(Signal.end_of_data())
         elif finish == SourceFinishType.IMMEDIATE:
+            # stopped/aborted: no final snapshot exists, so this exit must
+            # NOT count as epoch coverage (a restore would replay from zero)
+            self.finished_clean = False
             self.collector.broadcast(Signal.stop())
         # FINAL: checkpoint-then-stop already broadcast the barrier; end data.
         if finish == SourceFinishType.FINAL:
@@ -253,6 +272,7 @@ class Task:
                     op.handle_commit(msg.epoch, self.ctx)
 
         while True:
+            self.last_progress = time.monotonic()
             drain_control()
             if pending:
                 idx, item = pending.popleft()
@@ -263,6 +283,7 @@ class Task:
                 got = self.inbox.get(timeout=timeout) if self.inbox else None
                 if got is None:
                     if self.inbox is not None and self.inbox.closed:
+                        self.finished_clean = False
                         return  # engine aborted the pipeline
                     if tick_s is not None and time.monotonic() - last_tick >= tick_s:
                         op.handle_tick(self.ctx, self.collector)
@@ -290,6 +311,24 @@ class Task:
                 merged_watermark_changed()
             elif sig.kind == SignalKind.BARRIER:
                 b = sig.barrier
+                if current_barrier is not None and b.epoch < current_barrier.epoch:
+                    # stale barrier of a subsumed epoch straggling in after
+                    # the controller's stuck-checkpoint retry: a newer
+                    # alignment is already in progress — joining the old one
+                    # would skew this input's epoch tracking permanently
+                    continue
+                if current_barrier is not None and b.epoch > current_barrier.epoch:
+                    # a retried epoch overtook a wedged alignment (the
+                    # controller subsumed the old epoch after its
+                    # checkpoint.timeout-ms): abandon it and replay the held
+                    # traffic — the blocked inputs' own newer barriers sit at
+                    # the front of their held queues and re-join below
+                    current_barrier = None
+                    barrier_inputs.clear()
+                    blocked.clear()
+                    for i in sorted(held):
+                        pending.extend(held[i])
+                    held.clear()
                 if current_barrier is None:
                     current_barrier = b
                     self._resp("checkpoint_event", checkpoint_event=CheckpointEvent(
@@ -309,6 +348,8 @@ class Task:
                 # a pending alignment may now be complete
                 try_complete_alignment()
             elif sig.kind == SignalKind.STOP:
+                # hard stop: state since the last barrier is NOT persisted
+                self.finished_clean = False
                 self.collector.broadcast(Signal.stop())
                 break
             if stopping:

@@ -41,6 +41,14 @@ Instrumented sites:
     worker.heartbeat    worker->controller heartbeat emission (drop to
                         starve the controller's liveness check)
     node.start_worker   node daemon worker admission (ctx: job)
+    controller_rpc      controller->node-daemon HTTP surface (ctx: key=path,
+                        op=post|get): drop/delay/dup commands and event
+                        polls — recovery is protocol-level (buffered event
+                        queues, watchdog re-trigger, cumulative commits),
+                        never a pretend-success
+    commit              phase-2 commit fan-out of the controller's 2PC
+                        (ctx: epoch, worker); drop proves a lost commit is
+                        re-delivered with the next epoch, not lost
 """
 
 from __future__ import annotations
@@ -69,7 +77,7 @@ SITES = (
     "storage.put", "storage.get", "storage.delete", "storage.list",
     "storage.multipart", "network.send", "network.recv", "queue.put",
     "connector.poll", "connector.commit", "worker", "worker.heartbeat",
-    "node.start_worker",
+    "node.start_worker", "controller_rpc", "commit",
 )
 
 

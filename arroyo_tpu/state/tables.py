@@ -391,7 +391,17 @@ class TableManager:
                     (p, fm, d) for p, fm, d in entries
                     if int(fm.get("generation", 0)) >= 1 or d not in compacted_dirs
                 ]
-        by_table = {t: [(p, fm) for p, fm, _d in es] for t, es in by_table.items()}
+        # "final"-snapshot fallback files must load BEFORE the epoch's own
+        # files: global-keyed loads merge dict-style (last write wins), and a
+        # drained subtask's final snapshot may hold an older copy of a key a
+        # live subtask kept advancing (e.g. a shared source offset) — the
+        # epoch's value is the fresher one and must win the merge
+        final_dir_last = final_dir
+        by_table = {
+            t: [(p, fm) for p, fm, _d in
+                sorted(es, key=lambda pfd: pfd[2] != final_dir_last)]
+            for t, es in by_table.items()
+        }
         for tname, entries in by_table.items():
             spec = spec_by_name.get(tname)
             kind = entries[0][1].get("kind")
@@ -562,6 +572,23 @@ def cleanup_checkpoints(storage_url: str, job_id: str, min_epoch: int) -> int:
             storage.rmtree(os.path.join(base, fn))
             removed += 1
     return removed
+
+
+def subsume_torn_epoch(storage_url: str, job_id: str, epoch: int) -> bool:
+    """Remove a wedged epoch's partial shards (controller stuck-checkpoint
+    recovery): some subtasks wrote state files but the epoch never went
+    globally durable. Safe by the same crash-consistency rule the chaos
+    suite proves for compaction — an epoch directory WITHOUT its job-level
+    metadata marker is invisible to restore, so deleting it cannot lose
+    state. Refuses to touch a complete epoch (marker present): those are
+    restore targets and only epoch GC may drop them."""
+    d = checkpoint_dir(storage_url, job_id, epoch)
+    if storage.exists(os.path.join(d, "metadata.json")):
+        return False
+    if not storage.isdir(d):
+        return False
+    storage.rmtree(d)
+    return True
 
 
 def write_job_checkpoint_metadata(

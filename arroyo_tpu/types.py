@@ -183,3 +183,9 @@ class ControlResp:
     checkpoint_event: Optional[CheckpointEvent] = None
     subtask_metadata: Optional[dict] = None  # checkpoint_completed payload
     epoch: int = 0
+    # task_finished only: True when the task drained cleanly (graceful EOF /
+    # checkpoint-then-stop) so its state is final/durable and may stand in
+    # for epoch coverage; False for stop/abort exits, whose state is NOT
+    # durable — counting those would let an epoch go "complete" while a
+    # subtask's snapshot is missing (sources would then replay from zero)
+    clean: bool = True

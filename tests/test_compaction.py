@@ -82,3 +82,28 @@ def test_compact_job_and_cleanup(_storage):
     assert not os.path.isdir(checkpoint_dir(store, "job", 1))
     rows, g = _restore_all(store, 2, 2, specs)
     assert len(rows) == 4 and len(g) == 2
+
+
+def test_restore_epoch_state_wins_over_final_fallback(tmp_path, _storage):
+    """A drained subtask's "final" snapshot may hold a STALE CLONE of a key
+    a live subtask kept advancing (global tables replicate across shards on
+    restore — e.g. the single_file reader's line offset). When the final-dir
+    fallback fills in the drained subtask, the epoch's own (fresher) value
+    must win the merge, or a restore replays the source from the stale
+    offset while downstream state keeps its rows — duplicated output (the
+    exact corruption the 2-worker chaos axis once hit)."""
+    url = _storage
+    # subtask 0 (the live reader) snapshotted offset 285 at epoch 8
+    tm0 = TableManager(TaskInfo("job", "src", "source", 0, 2), url)
+    tm0.global_keyed("s").insert(0, 285)
+    tm0.checkpoint(8, None)
+    # subtask 1 drained long ago; its final snapshot carries a stale clone
+    # of subtask 0's offset under the SAME key
+    tm1 = TableManager(TaskInfo("job", "src", "source", 1, 2), url)
+    tm1.global_keyed("s").insert(0, 30)
+    tm1.checkpoint("final", None)
+    # restore at epoch 8: subtask 1 is absent there -> final fallback kicks
+    # in, but must not clobber the epoch's offset
+    tmr = TableManager(TaskInfo("job", "src", "source", 0, 2), url)
+    tmr.restore(8, [TableSpec("s", "global_keyed")])
+    assert tmr.global_keyed("s").get(0) == 285

@@ -202,6 +202,256 @@ def test_live_rescale_midstream(tmp_path, _storage):
         api.stop()
 
 
+def test_subsume_torn_epoch_refuses_complete_epochs(tmp_path, _storage):
+    """The stuck-checkpoint watchdog's cleanup may only delete epochs that
+    never went globally durable (no job-level metadata marker)."""
+    import os as _os
+
+    from arroyo_tpu.state.tables import (
+        checkpoint_dir,
+        subsume_torn_epoch,
+        write_job_checkpoint_metadata,
+    )
+
+    url = _storage
+    # epoch 1: complete (marker present) -> refused
+    write_job_checkpoint_metadata(url, "j1", 1, {"operators": []})
+    assert subsume_torn_epoch(url, "j1", 1) is False
+    assert _os.path.isdir(checkpoint_dir(url, "j1", 1))
+    # epoch 2: torn (shards, no marker) -> subsumed
+    _os.makedirs(_os.path.join(checkpoint_dir(url, "j1", 2), "operator-x"))
+    assert subsume_torn_epoch(url, "j1", 2) is True
+    assert not _os.path.isdir(checkpoint_dir(url, "j1", 2))
+    # epoch 3: nothing on disk -> no-op
+    assert subsume_torn_epoch(url, "j1", 3) is False
+
+
+def test_stuck_checkpoint_watchdog_subsume_retry_recover(tmp_path, _storage):
+    """A subtask hangs mid-epoch-2-snapshot: the checkpoint.timeout-ms
+    watchdog must declare the epoch failed (db record), subsume its torn
+    shards, retry at a fresh epoch, and — after max-consecutive-failures —
+    restore the whole worker set from the last globally complete
+    checkpoint, finishing with golden output."""
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu import faults
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    cfg.update({
+        "controller.workers-per-job": 2,
+        "checkpoint.interval-ms": 100,
+        "checkpoint.timeout-ms": 400,
+        "checkpoint.max-consecutive-failures": 2,
+        # only the watchdog may fire here, not heartbeat detection
+        "pipeline.worker-heartbeat-timeout-ms": 60_000,
+        "testing.source-read-delay-micros": 4000,
+    })
+    faults.install("worker:hang=6@barrier=2&step=1", seed=7)
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+    try:
+        pid = db.create_pipeline("agg", sql, 2)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        jc = ctl.jobs[jid]
+        # watchdog fired: some epoch was declared failed and subsumed
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if any(c["state"] == "failed" for c in db.list_checkpoints(jid)):
+                break
+            time.sleep(0.05)
+        assert any(c["state"] == "failed" for c in db.list_checkpoints(jid)), (
+            "stuck epoch was never declared failed")
+        # escalation: K consecutive wedges -> whole-set restore
+        state = ctl.wait_for_state(jid, "Finished", timeout=120)
+        assert state == "Finished"
+        job = db.get_job(jid)
+        assert int(job["restarts"]) >= 1, "wedged set was never restored"
+        assert jc.watchdog_failed_epochs >= 2
+        assert jc.watchdog_escalations >= 1, (
+            "K consecutive wedges never escalated to a whole-set restore")
+        _assert_golden(out)
+    finally:
+        faults.clear()
+        cfg.update({"controller.workers-per-job": 1,
+                    "checkpoint.interval-ms": 10_000,
+                    "checkpoint.timeout-ms": 600_000,
+                    "checkpoint.max-consecutive-failures": 3,
+                    "testing.source-read-delay-micros": 0})
+        ctl.stop()
+
+
+def test_embedded_hung_worker_heartbeat_detected(tmp_path, _storage):
+    """EmbeddedWorkerHandle.last_heartbeat derives from actual engine
+    progress (task run-loop beats), so an engine wedged inside a snapshot
+    trips the controller's heartbeat timeout even though its threads still
+    exist; the job recovers from the last complete checkpoint."""
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu import faults
+
+    sql, out = _sql(tmp_path, name="select_star")
+    db = Database()
+    cfg.update({
+        "checkpoint.interval-ms": 100,
+        "pipeline.worker-heartbeat-timeout-ms": 2500,
+        "testing.source-read-delay-micros": 4000,
+    })
+    # epoch 1 completes; the first subtask into epoch 2's snapshot wedges
+    # far longer than the heartbeat timeout
+    faults.install("worker:hang=12@barrier=2&step=1", seed=7)
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+    try:
+        pid = db.create_pipeline("sel", sql, 2)
+        jid = db.create_job(pid)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            job = db.get_job(jid)
+            if job and int(job["restarts"] or 0) >= 1:
+                break
+            time.sleep(0.05)
+        job = db.get_job(jid)
+        assert int(job["restarts"] or 0) >= 1, "hung embedded worker never detected"
+        assert "heartbeat" in (job["failure_message"] or "")
+        state = ctl.wait_for_state(jid, "Finished", timeout=120)
+        assert state == "Finished"
+        _assert_golden(out, name="select_star")
+    finally:
+        faults.clear()
+        cfg.update({"checkpoint.interval-ms": 10_000,
+                    "pipeline.worker-heartbeat-timeout-ms": 30_000,
+                    "testing.source-read-delay-micros": 0})
+        ctl.stop()
+
+
+def test_controller_checkpoint_gc(tmp_path, _storage):
+    """checkpoint.compaction.epochs drives controller-side GC: every K
+    completed epochs the newest complete one is compacted and older epochs
+    dropped — never past the newest complete epoch, never the "final"
+    drained-source snapshots."""
+    import os as _os
+
+    from arroyo_tpu import config as cfg
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    cfg.update({
+        "checkpoint.interval-ms": 100,
+        "checkpoint.compaction.epochs": 2,
+        "testing.source-read-delay-micros": 4000,
+    })
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+    try:
+        pid = db.create_pipeline("agg", sql, 2)
+        jid = db.create_job(pid)
+        state = ctl.wait_for_state(jid, "Finished", timeout=120)
+        assert state == "Finished"
+        # GC runs on a background thread; give the last round a moment
+        deadline = time.monotonic() + 15
+        compacted: list = []
+        while time.monotonic() < deadline and not compacted:
+            cks = db.list_checkpoints(jid)
+            compacted = [c["epoch"] for c in cks if c["state"] == "compacted"]
+            if not compacted:
+                time.sleep(0.1)
+        assert compacted, f"GC never ran: {cks}"
+        base = _os.path.join(_storage, jid, "checkpoints")
+        remaining = sorted(
+            int(fn.split("-")[1]) for fn in _os.listdir(base)
+            if fn.startswith("checkpoint-") and fn.split("-")[1].isdigit())
+        # everything older than the newest compacted epoch was dropped
+        assert remaining and min(remaining) >= max(compacted), (
+            f"GC left epochs {remaining} older than compacted {compacted}")
+        assert _os.path.isdir(_os.path.join(base, "checkpoint-final")), (
+            "GC must never delete the final drained-source snapshots")
+        _assert_golden(out)
+    finally:
+        cfg.update({"checkpoint.interval-ms": 10_000,
+                    "checkpoint.compaction.epochs": 0,
+                    "testing.source-read-delay-micros": 0})
+        ctl.stop()
+
+
+def test_multi_worker_rescale(tmp_path, _storage):
+    """Rescaling a 2-worker job: the whole set drains behind one stopping
+    checkpoint (globally durable via the coordinator), then reschedules at
+    the new parallelism — still 2 workers — restoring from it."""
+    from arroyo_tpu import config as cfg
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    cfg.update({
+        "controller.workers-per-job": 2,
+        "checkpoint.interval-ms": 150,
+        "testing.source-read-delay-micros": 4000,
+    })
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+    try:
+        pid = db.create_pipeline("agg", sql, 2)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        time.sleep(0.3)  # let some input flow at p=2
+        db.update_job(jid, desired_parallelism=3)
+        seen = set()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            seen.add(db.get_job(jid)["state"])
+            if "Rescaling" in seen and db.get_job(jid)["state"] == "Running":
+                break
+            time.sleep(0.01)
+        assert "Rescaling" in seen, f"states seen: {seen}"
+        jc = ctl.jobs[jid]
+        assert jc.parallelism == 3
+        # the rescale restored from the drain checkpoint, not from scratch
+        assert jc.restore_epoch is not None
+        cfg.update({"testing.source-read-delay-micros": 0})
+        state = ctl.wait_for_state(jid, "Finished", timeout=120)
+        assert state == "Finished"
+        job = db.get_job(jid)
+        assert int(job["n_workers"]) == 2
+        assert db.get_pipeline(pid)["parallelism"] == 3
+        _assert_golden(out)
+    finally:
+        cfg.update({"controller.workers-per-job": 1,
+                    "checkpoint.interval-ms": 10_000,
+                    "testing.source-read-delay-micros": 0})
+        ctl.stop()
+
+
+def test_process_scheduler_two_worker_set(tmp_path, _storage):
+    """Full multi-process worker set: N subprocesses exchange data-plane
+    peers through the controller, relay per-subtask acks over the wire
+    protocol, and only complete epochs on controller-injected commits."""
+    from arroyo_tpu import config as cfg
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    os.environ["ARROYO_TPU__TESTING__SOURCE_READ_DELAY_MICROS"] = "8000"
+    os.environ["ARROYO_TPU__CHECKPOINT__STORAGE_URL"] = cfg.config().get(
+        "checkpoint.storage-url")
+    cfg.update({"controller.workers-per-job": 2,
+                "checkpoint.interval-ms": 300})
+    ctl = ControllerServer(db, ProcessScheduler()).start()
+    try:
+        pid = db.create_pipeline("agg", sql, 2)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=120)
+        jc = ctl.jobs[jid]
+        assert len(jc.handles) == 2
+        state = ctl.wait_for_state(jid, "Finished", timeout=180)
+        assert state == "Finished"
+        job = db.get_job(jid)
+        assert int(job["n_workers"]) == 2
+        # the coordinator (not any worker) recorded globally durable epochs
+        assert any(c["state"] == "complete" for c in db.list_checkpoints(jid))
+        assert jc.checkpoint_event_log, "no coordinated checkpoints happened"
+        _assert_golden(out)
+    finally:
+        os.environ.pop("ARROYO_TPU__TESTING__SOURCE_READ_DELAY_MICROS", None)
+        os.environ.pop("ARROYO_TPU__CHECKPOINT__STORAGE_URL", None)
+        cfg.update({"controller.workers-per-job": 1,
+                    "checkpoint.interval-ms": 10_000})
+        ctl.stop()
+
+
 def test_rest_api_lifecycle(tmp_path, _storage):
     from arroyo_tpu.api import ApiServer
 

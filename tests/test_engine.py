@@ -270,3 +270,50 @@ FROM (
     lab1 = sorted((_json.loads(l)["k"], _json.loads(l)["label"]) for l in open(out1))
     lab2 = sorted((_json.loads(l)["k"], _json.loads(l)["label"]) for l in open(out2))
     assert lab1 == lab2
+
+
+def test_checkpoint_and_wait_distinct_outcomes(tmp_path, _storage):
+    """checkpoint_and_wait must tell its three exits apart: a drained
+    pipeline ("finished") is a stop, a stuck barrier ("timeout") is a
+    failure whose diagnostic names the subtasks that never acked, and only
+    "completed" is truthy."""
+    import time
+
+    from arroyo_tpu.engine import engine as engine_mod
+    from arroyo_tpu.engine.engine import CheckpointWait, register_operator
+    from arroyo_tpu.operators.base import Operator
+
+    # (a) pipeline finished before the barrier -> "finished", falsy
+    g, _rows = impulse_to_vec(count=10)
+    eng = Engine(g, job_id="cw-finished")
+    eng.start()
+    eng.join(timeout=30)
+    res = eng.checkpoint_and_wait(1, timeout=5)
+    assert isinstance(res, CheckpointWait)
+    assert not res and res.outcome == "finished" and res.missing == ()
+
+    # (b) a wedged operator -> "timeout", with the unacked subtask named
+    class Staller(Operator):
+        def process_batch(self, batch, ctx, collector, input_index=0):
+            time.sleep(5)
+
+    saved = engine_mod._CONSTRUCTORS.get(OpName.ASYNC_UDF)
+    register_operator(OpName.ASYNC_UDF)(lambda cfg: Staller())
+    try:
+        g2 = Graph()
+        g2.add_node(Node("src", OpName.SOURCE,
+                         {"connector": "impulse", "message_count": None,
+                          "event_rate": 5000}, 1))
+        g2.add_node(Node("stall", OpName.ASYNC_UDF, {}, 1))
+        g2.add_edge("src", "stall", EdgeType.FORWARD, DUMMY)
+        eng2 = Engine(g2, job_id="cw-timeout")
+        eng2.start()
+        time.sleep(0.3)  # let the staller pick up a batch
+        res2 = eng2.checkpoint_and_wait(1, timeout=1.5)
+        assert not res2 and res2.outcome == "timeout"
+        assert ("stall", 0) in res2.missing, res2
+        assert "stall" in repr(res2)
+        eng2._abort()
+    finally:
+        if saved is not None:
+            engine_mod._CONSTRUCTORS[OpName.ASYNC_UDF] = saved

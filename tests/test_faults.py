@@ -679,3 +679,121 @@ def test_node_admission_fault_surfaces_as_500(tmp_path, _storage):
         faults.clear()
         node.stop()
         api.stop()
+
+
+# ---------------------------------------- controller 2PC + control-plane RPC
+
+
+def test_controller_rpc_fault_drops_and_recovers_event_polls(_storage):
+    """A dropped controller->node event poll loses nothing: the daemon only
+    drains its buffer when a poll actually arrives, so the next poll
+    catches up. (Wire-level unit test against a stubbed daemon.)"""
+    from arroyo_tpu.controller.scheduler import NodeWorkerHandle
+
+    h = NodeWorkerHandle.__new__(NodeWorkerHandle)
+    h._buffer = []
+    h._alive = True
+    h._hb = time.monotonic()
+    h.worker_id = "w1"
+    h.node_addr = "http://node"
+    h.dp_port = None
+    calls: list = []
+
+    def fake_get(url, timeout=10.0):
+        calls.append(url)
+        return {"events": [{"event": "started"}], "alive": True, "hb_age_s": 0.0}
+
+    h._get = fake_get
+    faults.install("controller_rpc:drop@op=get&step=1")
+    try:
+        assert h.poll_events() == []  # dropped poll: the HTTP call never left
+        assert calls == []
+        assert h.poll_events() == [{"event": "started"}]  # next poll catches up
+        assert len(calls) == 1
+    finally:
+        faults.clear()
+
+
+def test_controller_rpc_fault_dup_and_drop_commands(_storage):
+    """drop/dup on node-daemon commands: a dropped command sends nothing
+    (recovery is protocol-level), a duplicated one posts twice — commit
+    delivery is idempotent/cumulative so dup is harmless."""
+    from arroyo_tpu.controller.scheduler import NodeWorkerHandle
+
+    h = NodeWorkerHandle.__new__(NodeWorkerHandle)
+    h._buffer = []
+    h._alive = True
+    h._hb = time.monotonic()
+    h.worker_id = "w1"
+    h.node_addr = "http://node"
+    h.dp_port = None
+    posts: list = []
+    h._post = lambda url, body, timeout=10.0: posts.append((url, body)) or {}
+    faults.install("controller_rpc:drop@op=post&step=1,"
+                   "controller_rpc:dup@op=post&step=2")
+    try:
+        h.send_commit(3)  # dropped: nothing on the wire
+        assert posts == []
+        h.send_commit(4)  # duplicated: posted twice
+        assert len(posts) == 2 and all(b["epoch"] == 4 for _u, b in posts)
+        h.send_commit(5)  # clean
+        assert len(posts) == 3
+    finally:
+        faults.clear()
+
+
+@pytest.mark.chaos
+def test_dropped_commit_redelivered_next_epoch(tmp_path, _storage):
+    """Chaos proof for the `commit` site: every worker's phase-2 commit for
+    epoch 1 is dropped; because commit delivery is cumulative, epoch 2's
+    commit first delivers epoch 1 — the dropped commit is re-delivered on
+    the next epoch, not lost — and the 2PC event log still shows metadata
+    durability strictly before every commit send."""
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.controller import ControllerServer, Database
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+
+    with open(os.path.join(SMOKE, "queries", "select_star.sql")) as f:
+        sql = f.read()
+    out = str(tmp_path / "out.json")
+    sql = sql.replace("$input_dir", os.path.join(SMOKE, "inputs")).replace(
+        "$output_path", out)
+    db = Database()
+    cfg.update({
+        "controller.workers-per-job": 2,
+        "checkpoint.interval-ms": 100,
+        "testing.source-read-delay-micros": 4000,
+    })
+    inj = faults.install("commit:drop@epoch=1", seed=11)
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+    try:
+        pid = db.create_pipeline("sel", sql, 2)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        jc = ctl.jobs[jid]
+        engines = [h.engine for h in jc.handles]
+        assert len(engines) == 2
+        state = ctl.wait_for_state(jid, "Finished", timeout=120)
+        assert state == "Finished"
+    finally:
+        faults.clear()
+        cfg.update({"controller.workers-per-job": 1,
+                    "checkpoint.interval-ms": 10_000,
+                    "testing.source-read-delay-micros": 0})
+        ctl.stop()
+    assert inj.fired_log, "commit drop never fired"
+    log = jc.checkpoint_event_log
+    assert any(ev[0] == "commit_dropped" and ev[1] == 1 for ev in log), log
+    assert not any(ev[0] == "commit_sent" and ev[1] == 1 for ev in log), log
+    # re-delivery: epoch 2's commit delivered epoch 1 first, in order
+    for eng in engines:
+        assert 1 in eng.delivered_commits and 2 in eng.delivered_commits, (
+            eng.delivered_commits)
+        assert eng.delivered_commits.index(1) < eng.delivered_commits.index(2)
+    # ordering invariant still holds for everything that WAS sent
+    durable_at = {}
+    for i, ev in enumerate(log):
+        if ev[0] == "metadata_durable":
+            durable_at.setdefault(ev[1], i)
+        elif ev[0] in ("commit_sent", "commit_dropped"):
+            assert ev[1] in durable_at and durable_at[ev[1]] < i, log

@@ -140,10 +140,18 @@ def build(sql: str, parallelism: int, job_id: str, restore_epoch=None):
     return eng
 
 
-def build_two_workers(graph_json: str, job_id: str, restore_epoch=None):
+def build_two_workers(graph_json: str, job_id: str, restore_epoch=None,
+                      coordinate: bool = False):
     """Split a planned graph across two in-process Engines joined by the
     TCP data plane: source nodes on worker 0, everything else on worker 1
-    (guarantees remote edges for the partition chaos axis)."""
+    (guarantees remote edges for the partition chaos axis).
+
+    Engines under an assignment are pure 2PC participants — they relay
+    acks upward and only complete epochs on an injected commit — so runs
+    that take checkpoints need ``coordinate=True`` to attach the
+    controller-style EngineSetCoordinator (it writes the job-level
+    metadata marker at global coverage and fans commits back)."""
+    from arroyo_tpu.controller.checkpoint_state import EngineSetCoordinator
     from arroyo_tpu.engine.engine import Engine
     from arroyo_tpu.engine.network import NetworkManager
     from arroyo_tpu.graph import Graph
@@ -162,7 +170,8 @@ def build_two_workers(graph_json: str, job_id: str, restore_epoch=None):
                 worker_index=0, network=nm0, restore_epoch=restore_epoch)
     w1 = Engine(Graph.loads(graph_json), job_id=job_id, assignment=assignment,
                 worker_index=1, network=nm1, restore_epoch=restore_epoch)
-    return (w0, w1), (nm0, nm1)
+    coord = EngineSetCoordinator([w0, w1]).start() if coordinate else None
+    return (w0, w1), (nm0, nm1), coord
 
 
 def wait_epoch(engine, epoch: int, timeout: float = 60.0) -> bool:
@@ -188,6 +197,23 @@ def wait_epoch(engine, epoch: int, timeout: float = 60.0) -> bool:
 
 CHAOS_FAMILIES = ["select_star", "tumbling_aggregates", "sliding_window"]
 CHAOS_SEED = 1337
+
+
+def assert_commit_after_durable(event_log):
+    """The distributed-2PC safety invariant: no phase-2 commit may ever be
+    sent for an epoch before that epoch's job-level metadata is durable
+    across ALL workers (the coordinator appends to this ordered log)."""
+    durable_at: dict[int, int] = {}
+    commits = 0
+    for i, ev in enumerate(event_log):
+        if ev[0] == "metadata_durable":
+            durable_at.setdefault(ev[1], i)
+        elif ev[0] in ("commit_sent", "commit_dropped"):
+            commits += 1
+            assert ev[1] in durable_at and durable_at[ev[1]] < i, (
+                f"commit for epoch {ev[1]} at log[{i}] precedes its "
+                f"metadata durability: {event_log}")
+    assert commits, f"no commits were ever fanned out: {event_log}"
 
 
 @pytest.mark.chaos
@@ -252,7 +278,8 @@ def test_chaos_dataplane_partition_mid_stream(name, tmp_path, _storage):
     graph_json = pp.graph.dumps()
 
     cfg.update({"testing.source-gate-epochs": 2})
-    (w0, w1), (nm0, nm1) = build_two_workers(graph_json, job_id)
+    (w0, w1), (nm0, nm1), coord = build_two_workers(graph_json, job_id,
+                                                    coordinate=True)
     try:
         w1.build()
         w0.build()
@@ -273,13 +300,19 @@ def test_chaos_dataplane_partition_mid_stream(name, tmp_path, _storage):
             w1.join(timeout=30)
         except RuntimeError:
             pass  # receiver-side tasks may also report the cut
+        coord.stop()
         nm0.close()
         nm1.close()
 
     storage_url = cfg.config().get("checkpoint.storage-url")
     assert latest_complete_checkpoint(storage_url, job_id) == 1
+    # torn epoch 2 must never have gone durable, and the 2PC trail must show
+    # metadata durability strictly preceding every commit for epoch 1
+    assert_commit_after_durable(coord.event_log)
+    assert all(ev[1] == 1 for ev in coord.event_log
+               if ev[0] in ("metadata_durable", "commit_sent"))
 
-    (r0, r1), (rm0, rm1) = build_two_workers(graph_json, job_id, restore_epoch=1)
+    (r0, r1), (rm0, rm1), _ = build_two_workers(graph_json, job_id, restore_epoch=1)
     try:
         r1.build()
         r0.build()
@@ -290,6 +323,61 @@ def test_chaos_dataplane_partition_mid_stream(name, tmp_path, _storage):
     finally:
         rm0.close()
         rm1.close()
+    assert_outputs(name, out)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("name", CHAOS_FAMILIES)
+def test_chaos_worker_set_crash_mid_checkpoint(name, tmp_path, _storage):
+    """2-worker assignment axis: a controller-supervised worker SET
+    (controller.workers-per-job=2, subtasks round-robined across both,
+    remote edges over the TCP data plane) loses one worker to a crash
+    mid-epoch-2 — after its shards land but before the epoch is globally
+    durable. The controller must kill the whole set, restore BOTH workers
+    from the last globally complete checkpoint, and reproduce the goldens
+    byte-exact; the coordinator's ordered event log must show job-level
+    metadata durable before every phase-2 commit."""
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu import faults
+    from arroyo_tpu.controller import ControllerServer, Database
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+
+    out = str(tmp_path / "out.json")
+    sql = load_sql(name, out)
+    db = Database()
+    cfg.update({
+        "controller.workers-per-job": 2,
+        "checkpoint.interval-ms": 150,
+        "testing.source-read-delay-micros": 4000,
+    })
+    inj = faults.install("worker:crash@barrier=2&step=1", seed=CHAOS_SEED)
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+    try:
+        pid = db.create_pipeline(name, sql, 2)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        jc = ctl.jobs[jid]  # survives recovery; holds the 2PC event log
+        import time as _time
+
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline and len(jc.handles) != 2:
+            _time.sleep(0.05)  # may race the crash/recovery window
+        assert len(jc.handles) == 2, "worker set never reached 2 workers"
+        state = ctl.wait_for_state(jid, "Finished", timeout=180)
+        assert state == "Finished"
+        job = db.get_job(jid)
+        assert int(job["restarts"]) >= 1, "the crashed set was never restored"
+        assert int(job["n_workers"]) == 2
+    finally:
+        faults.clear()
+        cfg.update({"controller.workers-per-job": 1,
+                    "checkpoint.interval-ms": 10_000,
+                    "testing.source-read-delay-micros": 0})
+        ctl.stop()
+    assert inj.fired_log, "crash fault never fired"
+    # no commit ever preceded its epoch's global durability — across BOTH
+    # worker-set incarnations (the log survives the restore)
+    assert_commit_after_durable(jc.checkpoint_event_log)
     assert_outputs(name, out)
 
 
