@@ -26,6 +26,13 @@ Rule catalog:
     LR106 fault-site-coverage  storage/network/queue mutations must route
                                through ``faults`` hooks; every declared
                                fault site must be wired somewhere
+    LR107 emit-in-loop         direct ``collector.collect(...)`` inside a
+                               Python loop in operator hot-path code: one
+                               sub-threshold batch per iteration pays full
+                               per-batch overhead per emit; build columns
+                               across iterations and emit once (the
+                               coalescing layer smooths queue transits, but
+                               cannot remove per-collect routing work)
 
 Waivers: append ``# lint: waive LR1xx — justification`` on the flagged
 line (or the line above). A waiver with no justification text does not
@@ -367,6 +374,31 @@ def rule_lr106(mod: ModuleInfo) -> Iterable[Finding]:
                        "module's guarded helper) inside the operation")
 
 
+def rule_lr107(mod: ModuleInfo) -> Iterable[Finding]:
+    """Per-iteration emits in operator hot paths: N tiny batches through
+    collector -> queue -> data plane where one coalesced batch would do.
+    The fused multi-window closes (InstantJoin/SlidingAggregate) exist
+    precisely to keep this pattern out of the emission path."""
+    if not mod.in_dirs("operators", "windows", "ops"):
+        return
+    seen: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        for n in _walk_skipping_nested_defs(node):
+            if (isinstance(n, ast.Call) and _call_name(n) == "collect"
+                    and "collector" in _receiver_name(n).lower()
+                    and n.lineno not in seen):
+                seen.add(n.lineno)
+                yield (n.lineno,
+                       "collector.collect() inside a loop emits one "
+                       "sub-threshold batch per iteration through the full "
+                       "collector/queue/data-plane path",
+                       "accumulate the iterations' columns and emit one "
+                       "batch after the loop (see the fused multi-window "
+                       "closes), or waive with justification")
+
+
 RULES: tuple[tuple[str, Severity, object], ...] = (
     ("LR101", Severity.ERROR, rule_lr101),
     ("LR102", Severity.ERROR, rule_lr102),
@@ -374,6 +406,7 @@ RULES: tuple[tuple[str, Severity, object], ...] = (
     ("LR104", Severity.WARNING, rule_lr104),
     ("LR105", Severity.ERROR, rule_lr105),
     ("LR106", Severity.ERROR, rule_lr106),
+    ("LR107", Severity.ERROR, rule_lr107),
 )
 
 # fault sites every full-package lint must find wired (mirrors faults.SITES;

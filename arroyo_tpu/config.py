@@ -37,6 +37,20 @@ _DEFAULTS: dict[str, Any] = {
         "queue-size": 8192,  # rows of in-flight budget per input edge
         "task-slots": 16,
     },
+    "engine": {
+        # adaptive micro-batch coalescing on the emission path: sub-threshold
+        # output batches accumulate in the collector (and, cross-worker, as
+        # framed bytes in the data plane's send buffer) until a row/byte/time
+        # limit trips or a signal (watermark/barrier/stop/EOF) flushes them.
+        # Signals ALWAYS flush first, so ordering, barrier alignment, and
+        # byte-exact checkpoint recovery are untouched by coalescing.
+        "coalesce": {
+            "enabled": True,
+            "max-rows": 4096,      # flush once this many rows are pending
+            "max-bytes": 1_048_576,  # ... or this many (approximate) bytes
+            "max-delay-ms": 5,     # ... or the oldest pending row is this old
+        },
+    },
     "device": {
         # TPU runtime knobs (no reference equivalent; this is the jax backend)
         "enabled": True,  # lower window aggregates to jax when possible

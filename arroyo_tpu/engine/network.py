@@ -7,20 +7,85 @@ signals (native/wire.py standing in for Arrow IPC). Backpressure is
 end-to-end: the reader blocks on the destination task's bounded inbox,
 TCP backpressures the sender (reference network_manager.rs:164-195).
 
+Frame coalescing (ISSUE 5): encoded DATA frames append to a per-connection
+send buffer and one writev-style syscall carries many small batches; any
+SIGNAL frame flushes the buffer first (in-line, same ordering guarantee as
+the collector's coalescing layer), as does a byte cap or the periodic
+flusher. Frame bytes and per-frame ordering are identical to the unbuffered
+path — the receiver cannot tell the difference.
+
 The byte transport itself is the C++ host runtime (cpp/arroyo_host.cc
 dp_* functions).
 """
 
 from __future__ import annotations
 
+import os
+import struct
 import threading
+import time
 from typing import Optional
 
 from ..batch import Batch
+from ..config import config
 from ..faults import InjectedFault, fault_point
 from ..native import MSG_DATA, MSG_SIGNAL, DataPlaneConn, DataPlaneListener
 from ..native.wire import decode_batch, decode_signal, encode_batch, encode_signal
 from ..types import Signal
+
+# MUST match cpp/arroyo_host.cc FrameHeader (6x uint32: quad, msg_type,
+# len) — the coalesced path packs frames host-side so one write carries
+# many; tests/test_coalesce.py round-trips python-packed frames through the
+# C receiver, so any layout drift fails there before it can desync a stream
+_HEADER = struct.Struct("=IIIIII")
+
+
+class _SendBuffer:
+    """Per-connection frame accumulator: many sub-threshold frames, one
+    syscall. Writes happen under the conn's send lock so buffered writes
+    and any direct ``conn.send`` never interleave mid-frame."""
+
+    def __init__(self, conn: DataPlaneConn, max_bytes: int):
+        self.conn = conn
+        self.max_bytes = max_bytes
+        self._chunks: list[bytes] = []
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._error: Optional[Exception] = None
+
+    def append(self, quad, mtype: int, payload: bytes, flush: bool) -> None:
+        frame = _HEADER.pack(*quad, mtype, len(payload)) + payload
+        with self._lock:
+            if self._error is not None:
+                # latched: once a flush failed the stream is torn mid-frame;
+                # every later append must fail too, never buffer-and-drop
+                raise self._error
+            self._chunks.append(frame)
+            self._bytes += len(frame)
+            if flush or self._bytes >= self.max_bytes:
+                self._flush_locked()
+
+    def flush_pending(self) -> None:
+        """Drain whatever is buffered; write errors surface to the next
+        sender (the periodic flusher has nobody to raise to)."""
+        with self._lock:
+            if self._chunks:
+                try:
+                    self._flush_locked()
+                except Exception as e:
+                    self._error = e
+
+    def _flush_locked(self) -> None:
+        blob = b"".join(self._chunks)
+        self._chunks, self._bytes = [], 0
+        with self.conn._send_lock:
+            view = memoryview(blob)
+            while view:
+                try:
+                    n = os.write(self.conn.fd, view)
+                except OSError as e:
+                    raise ConnectionError(f"data plane write failed: {e}") from e
+                view = view[n:]
 
 
 class RemoteDest:
@@ -43,13 +108,21 @@ class RemoteDest:
                               worker=self.worker)
         if verdict is not None and verdict[0] == "drop":
             return
-        conn = self.manager.conn_to(self.worker)
         if isinstance(item, Batch):
             payload, mtype = encode_batch(item), MSG_DATA
         elif isinstance(item, Signal):
             payload, mtype = encode_signal(item), MSG_SIGNAL
         else:
             raise TypeError(f"cannot ship {type(item)} over the data plane")
+        buf = self.manager.send_buffer_to(self.worker)
+        if buf is not None:
+            # signals flush in-line: a watermark/barrier frame must never
+            # overtake buffered data frames, and never linger behind them
+            buf.append(self.quad, mtype, payload, flush=mtype == MSG_SIGNAL)
+            if verdict is not None and verdict[0] == "dup":
+                buf.append(self.quad, mtype, payload, flush=mtype == MSG_SIGNAL)
+            return
+        conn = self.manager.conn_to(self.worker)
         conn.send(self.quad, mtype, payload)
         if verdict is not None and verdict[0] == "dup":
             conn.send(self.quad, mtype, payload)
@@ -68,8 +141,15 @@ class NetworkManager:
         # quad -> (inbox, flat_input_index)
         self._receivers: dict[tuple[int, int, int, int], tuple] = {}
         self._accept_thread: Optional[threading.Thread] = None
+        self._flush_thread: Optional[threading.Thread] = None
         self._reader_threads: list[threading.Thread] = []
         self._closed = False
+        c = config()
+        self._coalesce = bool(c.get("engine.coalesce.enabled", True))
+        self._co_max_bytes = int(c.get("engine.coalesce.max-bytes", 1 << 20))
+        self._co_max_delay_s = float(
+            c.get("engine.coalesce.max-delay-ms", 5)) / 1e3
+        self._send_bufs: dict[int, _SendBuffer] = {}
 
     def set_peers(self, peers: dict[int, tuple[str, int]]) -> None:
         self.peers = dict(peers)
@@ -83,6 +163,37 @@ class NetworkManager:
             target=self._accept_loop, daemon=True, name="dp-accept"
         )
         self._accept_thread.start()
+        if self._coalesce:
+            self._flush_thread = threading.Thread(
+                target=self._flush_loop, daemon=True, name="dp-flush"
+            )
+            self._flush_thread.start()
+
+    def send_buffer_to(self, worker: int) -> Optional[_SendBuffer]:
+        """The frame-coalescing buffer for this worker pair (None when
+        coalescing is disabled)."""
+        if not self._coalesce:
+            return None
+        buf = self._send_bufs.get(worker)
+        if buf is not None:
+            return buf
+        conn = self.conn_to(worker)
+        with self._out_lock:
+            buf = self._send_bufs.get(worker)
+            if buf is None:
+                buf = _SendBuffer(conn, self._co_max_bytes)
+                self._send_bufs[worker] = buf
+        return buf
+
+    def _flush_loop(self) -> None:
+        """Time-based safety flush: DATA frames not followed by a signal
+        (the common flush trigger) still leave within max-delay-ms. Every
+        non-empty buffer flushes each tick — an age test on a full-period
+        sleep would let a just-missed frame wait ~2x the knob."""
+        while not self._closed:
+            time.sleep(self._co_max_delay_s)
+            for buf in list(self._send_bufs.values()):
+                buf.flush_pending()
 
     def conn_to(self, worker: int) -> DataPlaneConn:
         with self._out_lock:
@@ -142,7 +253,11 @@ class NetworkManager:
     def close(self) -> None:
         self._closed = True
         self.listener.close()
+        for buf in list(self._send_bufs.values()):
+            # best-effort drain so frames sent just before close still land
+            buf.flush_pending()
         with self._out_lock:
+            self._send_bufs.clear()
             for conn in self._out.values():
                 conn.close()
             self._out.clear()

@@ -16,6 +16,7 @@ able to overtake a full queue exactly as in the reference.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Optional, Union
 
@@ -30,12 +31,15 @@ class TaskInbox:
     def __init__(self, n_inputs: int, row_budget: int):
         self.n_inputs = max(n_inputs, 1)
         self.row_budget = row_budget
-        self._queue: deque[tuple[int, QueueItem]] = deque()
+        # items carry their enqueue wall time: the consumer-side pop feeds
+        # the queue-transit latency histogram (coalescing instrumentation)
+        self._queue: deque[tuple[int, QueueItem, float]] = deque()
         self._used = [0] * self.n_inputs
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._budget_freed = threading.Condition(self._lock)
         self._closed = False
+        self.metrics = None  # TaskMetrics of the consuming task
 
     def put(self, input_index: int, item: QueueItem) -> None:
         """Blocks while this input's row budget is exhausted (data only)."""
@@ -60,7 +64,7 @@ class TaskInbox:
             if self._closed:
                 return
             self._used[input_index] += rows
-            self._queue.append((input_index, item))
+            self._queue.append((input_index, item, time.monotonic()))
             self._not_empty.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[tuple[int, QueueItem]]:
@@ -70,7 +74,10 @@ class TaskInbox:
                 self._not_empty.wait(timeout=timeout)
             if not self._queue:
                 return None
-            return self._queue.popleft()
+            idx, item, t_enq = self._queue.popleft()
+        if self.metrics is not None and isinstance(item, Batch):
+            self.metrics.queue_transit.observe(time.monotonic() - t_enq)
+        return idx, item
 
     def release(self, input_index: int, item: QueueItem) -> None:
         """Consumer finished processing; return the rows to the budget."""

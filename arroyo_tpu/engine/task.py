@@ -73,8 +73,10 @@ class SourceContext:
 
     def poll_control(self) -> Optional[ControlMessage]:
         # connector run loops poll between batches, so this doubles as the
-        # source-task liveness beat (Engine.heartbeat)
+        # source-task liveness beat (Engine.heartbeat) AND the time-based
+        # coalescing flush point for source emissions
         self._task.last_progress = time.monotonic()
+        self._task.collector.flush_expired(self._task.last_progress)
         try:
             return self._task.control_queue.get_nowait()
         except _queue.Empty:
@@ -121,6 +123,7 @@ class Task:
             self.metrics.queue_size = inbox.row_budget * inbox.n_inputs
             # an idle queue is an EMPTY queue, not a full one
             self.metrics.queue_rem = self.metrics.queue_size
+            inbox.metrics = self.metrics  # consumer-side transit histogram
         collector.metrics = self.metrics
 
     # ------------------------------------------------------------------ API
@@ -274,12 +277,21 @@ class Task:
         while True:
             self.last_progress = time.monotonic()
             drain_control()
+            # time-based coalescing flush: between items, pending sub-
+            # threshold rows older than max-delay-ms go out
+            self.collector.flush_expired(self.last_progress)
             if pending:
                 idx, item = pending.popleft()
             else:
                 timeout = 0.5
                 if tick_s is not None:
                     timeout = min(timeout, max(tick_s - (time.monotonic() - last_tick), 0.0))
+                deadline_f = self.collector.flush_deadline()
+                if deadline_f is not None:
+                    # wake exactly at the pending rows' delay deadline —
+                    # waiting a full max-delay from NOW would stretch the
+                    # worst-case hold to ~2x the knob
+                    timeout = min(timeout, max(deadline_f - time.monotonic(), 0.0))
                 got = self.inbox.get(timeout=timeout) if self.inbox else None
                 if got is None:
                     if self.inbox is not None and self.inbox.closed:

@@ -92,6 +92,12 @@ def _div(a, b):
     # SQL integer division truncates toward zero; numpy // floors.
     if _is_integer(a) and _is_integer(b):
         q = np.floor_divide(a, b)
+        # nonnegative operands (the hot case: event-time micros / positive
+        # window literals): floor == trunc, skip the 4-pass correction
+        a_nonneg = (a.size == 0 or np.min(a) >= 0) if np.ndim(a) else a >= 0
+        b_nonneg = (b.size == 0 or np.min(b) >= 0) if np.ndim(b) else b >= 0
+        if a_nonneg and b_nonneg:
+            return q
         r = np.mod(a, b)
         # correct floor -> trunc for mixed signs
         adjust = (r != 0) & ((np.sign(a if np.ndim(a) else np.asarray(a)) < 0) != (np.sign(b if np.ndim(b) else np.asarray(b)) < 0))

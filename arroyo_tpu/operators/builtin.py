@@ -33,6 +33,16 @@ class ValueOperator(Operator):
     def __init__(self, cfg: dict):
         self.projections: Optional[list[tuple[str, Expr]]] = cfg.get("projections")
         self.filter: Optional[Expr] = cfg.get("filter")
+        # with projections, the filter only needs to materialize the columns
+        # the projections (and the internal passthroughs below) read — not
+        # every source column (hot-path copy cut; q8 branch batches carry
+        # 2x the columns their projections touch)
+        self._needed: Optional[set] = None
+        if self.projections is not None:
+            needed = {TIMESTAMP_FIELD, KEY_FIELD, "_is_retract"}
+            for _name, e in self.projections:
+                needed |= e.columns()
+            self._needed = needed
 
     def process_batch(self, batch, ctx, collector, input_index=0):
         n = batch.num_rows
@@ -41,7 +51,11 @@ class ValueOperator(Operator):
             if not mask.any():
                 return
             if not mask.all():
-                batch = batch.filter(mask)
+                if self._needed is not None:
+                    batch = Batch({k: v[mask] for k, v in batch.columns.items()
+                                   if k in self._needed})
+                else:
+                    batch = batch.filter(mask)
             n = batch.num_rows
         if self.projections is None:
             collector.collect(batch)

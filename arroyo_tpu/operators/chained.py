@@ -8,7 +8,16 @@ ChainedCollector threading output of op N into op N+1 in place :370-422).
 
 On this engine a chain collapses per-batch queue hops and thread handoffs —
 the host-side analog of XLA op fusion, and a direct throughput lever since
-every hop costs a bounded-queue put/get plus a GIL switch."""
+every hop costs a bounded-queue put/get plus a GIL switch.
+
+Interplay with micro-batch coalescing (operators/collector.py): member-to-
+member hops are plain in-process calls, so there is deliberately NO
+coalescing buffer between chain members — only the chain's terminal
+collector (the task's real Collector) coalesces, right where the queue/
+data-plane overhead being amortized actually lives. Signal flushing is
+inherited from that terminal collector: a watermark threaded through
+ChainCollector.broadcast ends at Collector.broadcast, which flushes pending
+rows ahead of the signal."""
 
 from __future__ import annotations
 
@@ -72,6 +81,11 @@ class ChainedOperator(Operator):
         ]
         self._ctxs: Optional[list[OperatorContext]] = None
         self._cols = None
+        # only members that declared a tick interval get ticked: the chain
+        # ticks at the MINIMUM member interval, and waking every member at
+        # the fastest member's cadence is wasted hot-loop work
+        self._tickers = [i for i, m in enumerate(self.members)
+                         if m.tick_interval_micros() is not None]
 
     def name(self) -> str:
         return "+".join(m.name() for m in self.members)
@@ -146,8 +160,8 @@ class ChainedOperator(Operator):
 
     def handle_tick(self, ctx, collector) -> None:
         cols = self._chain_cols(collector)
-        for i, m in enumerate(self.members):
-            m.handle_tick(self._ctxs[i], cols[i])
+        for i in self._tickers:
+            self.members[i].handle_tick(self._ctxs[i], cols[i])
 
     def on_close(self, ctx, collector) -> None:
         cols = self._chain_cols(collector)

@@ -33,33 +33,54 @@ from ..types import Signal
 from .updating_aggregate import IS_RETRACT_FIELD
 
 
-def _object_col(values: list) -> np.ndarray:
-    out = np.empty(len(values), dtype=object)
-    for i, v in enumerate(values):
-        out[i] = v
-    return out
+def _object_col(values) -> np.ndarray:
+    """Object column from arbitrary python values in one shot (np.fromiter;
+    the per-element assignment loop this replaces re-allocated and filled
+    element-wise on every emitted batch of the wide-expansion path)."""
+    vals = values if isinstance(values, (list, tuple)) else list(values)
+    return np.fromiter(vals, dtype=object, count=len(vals))
 
 
-def _hash_join_indices(
-    left_keys: np.ndarray, right_keys: np.ndarray
-) -> tuple[np.ndarray, np.ndarray]:
-    """Inner-join row index pairs (li, ri) where keys match, vectorized:
-    sort the right side once, binary-search each left key, expand ranges."""
-    order = np.argsort(right_keys, kind="stable")
-    rk = right_keys[order]
-    lo = np.searchsorted(rk, left_keys, side="left")
-    hi = np.searchsorted(rk, left_keys, side="right")
-    counts = hi - lo
-    li = np.repeat(np.arange(len(left_keys)), counts)
-    # for each left row, offsets lo[l]..hi[l] into the sorted right
-    if len(li):
-        within = np.arange(len(li)) - np.repeat(
-            np.cumsum(counts) - counts, counts
-        )
-        ri = order[np.repeat(lo, counts) + within]
-    else:
-        ri = np.empty(0, dtype=np.int64)
-    return li, ri
+_null_cache = np.empty(0, dtype=object)
+
+
+def _null_col(n: int) -> np.ndarray:
+    """All-None object column, served as a view of one shared buffer and
+    reused across ``_emit`` calls (emitted columns are never mutated in
+    place downstream — filter/take/concat all copy)."""
+    global _null_cache
+    if len(_null_cache) < n:
+        _null_cache = np.empty(max(n, 2 * len(_null_cache), 1024), dtype=object)
+    return _null_cache[:n]
+
+
+def _jax_on_host_cpu() -> bool:
+    """True when the "device" backend would just run on the host CPU via
+    jax — there a device dispatch costs more than the numpy probe it
+    replaces (measured ~4x at q8 window sizes), so the join stays on
+    numpy unless ``device.force-device-join`` forces the device path
+    (tests)."""
+    from ..config import config
+
+    if config().get("device.force-device-join"):
+        return False
+    global _jax_cpu
+    if _jax_cpu is None:
+        try:
+            import jax
+
+            _jax_cpu = jax.default_backend() == "cpu"
+        except Exception:  # noqa: BLE001 - no jax at all: host numpy it is
+            _jax_cpu = True
+    return _jax_cpu
+
+
+_jax_cpu: Optional[bool] = None
+
+
+# the sort/search probe now lives beside its device twin (ops/join_probe);
+# this alias keeps the historic name importable
+from ..ops.join_probe import host_join_indices as _hash_join_indices  # noqa: E402
 
 
 class InstantJoin(Operator):
@@ -112,14 +133,37 @@ class InstantJoin(Operator):
             self.emitted_before = max(barriers)
 
     def _buffer(self, batch: Batch, side: int) -> None:
+        """One split per incoming batch: the per-unique-timestamp
+        ``filter(ts == t)`` this replaces rescanned the full column once per
+        window (O(uniq*n)). Upstream window stamping emits time-ordered
+        batches, so the common case needs no sort at all — per-timestamp
+        runs are already contiguous and stored as zero-copy slices; only a
+        genuinely unordered batch pays one stable argsort."""
         ts = batch.timestamps
-        uniq = np.unique(ts)
-        for t in uniq.tolist():
-            ent = self.buf.setdefault(int(t), ([], []))
-            if len(uniq) == 1:
-                ent[side].append(batch)
-            else:
-                ent[side].append(batch.filter(ts == t))
+        n = len(ts)
+        if n == 0:
+            return
+        d = np.diff(ts)
+        if len(d) == 0 or not (d < 0).any():
+            sorted_b, sts = batch, ts
+        else:
+            order = np.argsort(ts, kind="stable")
+            sorted_b = batch.take(order)
+            sts = ts[order]
+            d = np.diff(sts)
+        if n == 1 or not (d > 0).any():
+            self.buf.setdefault(int(sts[0]), ([], []))[side].append(sorted_b)
+            return
+        bounds = np.concatenate(([0], np.flatnonzero(d > 0) + 1, [n]))
+        for i in range(len(bounds) - 1):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            ent = self.buf.setdefault(int(sts[lo]), ([], []))
+            piece = sorted_b.slice(lo, hi)
+            if 4 * (hi - lo) <= n:
+                # a small view would pin the whole parent batch's columns
+                # until this window closes; materialize it instead
+                piece = Batch({k: v.copy() for k, v in piece.columns.items()})
+            ent[side].append(piece)
 
     def process_batch(self, batch, ctx, collector, input_index=0):
         if self._pending:
@@ -151,8 +195,29 @@ class InstantJoin(Operator):
     def _schedule_closed(self, before: Optional[int], wm, collector) -> bool:
         """Queue the join for every window closed by the watermark; the
         watermark marker is appended after its windows so emission order is
-        preserved. Returns True when anything was queued."""
+        preserved. Returns True when anything was queued.
+
+        When one watermark closes SEVERAL buffered windows (catch-up after a
+        gap, end-of-stream), the per-window pipeline would emit N tiny
+        batches each paying full collector/queue overhead; the fused path
+        concatenates the sides, probes once partitioned by window, and emits
+        one coalesced batch per match category instead."""
         ts_list = sorted(t for t in self.buf if before is None or t < before)
+        if len(ts_list) > 1 and (self.backend != "jax" or _jax_on_host_cpu()):
+            # host-probe backends only: on a real accelerator the per-window
+            # pipelined device closes below stay in charge (their async
+            # dispatch hides probe latency, and the collector's coalescing
+            # still merges the small per-window output batches), so fusing
+            # must not silently demote the heaviest closes to the host.
+            # Earlier in-flight closes (and their held watermarks) must
+            # drain first so emission order is preserved.
+            self._drain_pending(collector, force=True)
+            self._fused_close(ts_list, collector)
+            if before is not None and (
+                self.emitted_before is None or before > self.emitted_before
+            ):
+                self.emitted_before = before
+            return False  # rows already emitted; the watermark may forward
         for t in ts_list:
             left, right = self.buf.pop(t)
             while len(self._pending) >= 16:  # bound in-flight joins
@@ -179,13 +244,63 @@ class InstantJoin(Operator):
         handle = None
         if lb is not None and rb is not None:
             n = max(lb.num_rows, rb.num_rows)
-            if self.backend == "jax" and n >= self.device_min_rows:
+            if (self.backend == "jax" and n >= self.device_min_rows
+                    and not _jax_on_host_cpu()):
                 from ..ops.join_probe import device_join_start
 
                 lk = lb.keys.astype(np.uint64).view(np.int64)
                 rk = rb.keys.astype(np.uint64).view(np.int64)
                 handle = device_join_start(lk, rk)
         return (handle, t, lb, rb, None)
+
+    def _fused_close(self, ts_list: list, collector) -> None:
+        """Close every window in ts_list as ONE join: single probe over the
+        concatenated sides partitioned by window, one output batch per match
+        category (inner pairs / left pads / right pads) instead of N
+        per-window emits. Rows carry their own window timestamps, so the
+        emitted groups are identical to per-window closes."""
+        from ..ops.join_probe import fused_join_indices
+
+        jt = self.join_type
+        lbs: dict[int, Batch] = {}
+        rbs: dict[int, Batch] = {}
+        for t in ts_list:
+            left, right = self.buf.pop(t)
+            if left:
+                lbs[t] = Batch.concat(left)
+            if right:
+                rbs[t] = Batch.concat(right)
+        both = [t for t in ts_list if t in lbs and t in rbs]
+        if both:
+            lb = Batch.concat([lbs[t] for t in both])
+            rb = Batch.concat([rbs[t] for t in both])
+            l_bounds = np.cumsum([0] + [lbs[t].num_rows for t in both])
+            r_bounds = np.cumsum([0] + [rbs[t].num_rows for t in both])
+            lk = lb.keys.astype(np.uint64).view(np.int64)
+            rk = rb.keys.astype(np.uint64).view(np.int64)
+            li, ri = fused_join_indices(lk, rk, l_bounds, r_bounds)
+            if len(li):
+                self._emit(None, lb, rb, li, ri, collector)
+            if jt in ("left", "full"):
+                unmatched = np.ones(lb.num_rows, dtype=bool)
+                unmatched[li] = False
+                if unmatched.any():
+                    self._emit(None, lb.filter(unmatched), None, None, None, collector)
+            if jt in ("right", "full"):
+                unmatched = np.ones(rb.num_rows, dtype=bool)
+                unmatched[ri] = False
+                if unmatched.any():
+                    self._emit(None, None, rb.filter(unmatched), None, None, collector)
+        if jt in ("left", "full"):
+            lonely = [t for t in ts_list if t in lbs and t not in rbs]
+            if lonely:
+                self._emit(None, Batch.concat([lbs[t] for t in lonely]),
+                           None, None, None, collector)
+        if jt in ("right", "full"):
+            lonely = [t for t in ts_list if t in rbs and t not in lbs]
+            if lonely:
+                self._emit(None, None, Batch.concat([rbs[t] for t in lonely]),
+                           None, None, collector)
 
     def _drain_pending(self, collector, force: bool = False) -> None:
         while self._pending:
@@ -232,7 +347,9 @@ class InstantJoin(Operator):
     def _emit(self, t, lb, rb, li, ri, collector) -> None:
         """One output batch. With index arrays (matched-pair path) only the
         PROJECTED columns are gathered — Batch.take would copy every column
-        including internals, doubling the close cost of a wide expansion."""
+        including internals, doubling the close cost of a wide expansion.
+        ``t``: the window start, or None for the fused multi-window path
+        where each row carries its own window timestamp already."""
         if li is not None:
             n = len(li)
         else:
@@ -240,17 +357,22 @@ class InstantJoin(Operator):
         cols: dict[str, np.ndarray] = {}
         for out_name, src in self.left_names:
             if lb is None:
-                cols[out_name] = _object_col([None] * n)
+                cols[out_name] = _null_col(n)
             else:
                 col = np.asarray(lb[src])
                 cols[out_name] = col[li] if li is not None else col
         for out_name, src in self.right_names:
             if rb is None:
-                cols[out_name] = _object_col([None] * n)
+                cols[out_name] = _null_col(n)
             else:
                 col = np.asarray(rb[src])
                 cols[out_name] = col[ri] if ri is not None else col
-        cols[TIMESTAMP_FIELD] = np.full(n, t, dtype=np.int64)
+        if t is not None:
+            cols[TIMESTAMP_FIELD] = np.full(n, t, dtype=np.int64)
+        else:
+            src_ts = (lb if lb is not None else rb).timestamps
+            cols[TIMESTAMP_FIELD] = (
+                src_ts[li] if (lb is not None and li is not None) else src_ts)
         src_keys = lb if lb is not None else rb
         if KEY_FIELD in src_keys:
             k = np.asarray(src_keys.keys)
@@ -272,15 +394,79 @@ class InstantJoin(Operator):
         )
 
 
-class _StoredRow:
-    __slots__ = ("values", "ts", "key", "match_count", "null_emitted")
+class _SideStore:
+    """Columnar buffer of one join side's live rows (amortized-growth
+    arrays, dead rows masked then compacted): the vectorized probe target
+    that replaced JoinWithExpiration's per-row dict-of-_StoredRow store."""
 
-    def __init__(self, values: tuple, ts: int, key: int):
-        self.values = values
-        self.ts = ts
-        self.key = key
-        self.match_count = 0
-        self.null_emitted = False
+    __slots__ = ("n", "cap", "keys", "ts", "match_count", "null_emitted",
+                 "alive", "vals", "n_dead")
+
+    def __init__(self, n_vals: int, cap: int = 1024):
+        self.n = 0
+        self.cap = cap
+        self.keys = np.empty(cap, dtype=np.int64)
+        self.ts = np.empty(cap, dtype=np.int64)
+        self.match_count = np.empty(cap, dtype=np.int64)
+        self.null_emitted = np.empty(cap, dtype=bool)
+        self.alive = np.zeros(cap, dtype=bool)
+        self.vals = [np.empty(cap, dtype=object) for _ in range(n_vals)]
+        self.n_dead = 0
+
+    def _grow(self, need: int) -> None:
+        cap = self.cap
+        while cap < self.n + need:
+            cap *= 2
+        for name in ("keys", "ts", "match_count", "null_emitted", "alive"):
+            old = getattr(self, name)
+            new = (np.zeros(cap, dtype=old.dtype) if name == "alive"
+                   else np.empty(cap, dtype=old.dtype))
+            new[: self.n] = old[: self.n]
+            setattr(self, name, new)
+        for i, old in enumerate(self.vals):
+            new = np.empty(cap, dtype=object)
+            new[: self.n] = old[: self.n]
+            self.vals[i] = new
+        self.cap = cap
+
+    def append(self, keys: np.ndarray, ts: np.ndarray, vals: list,
+               match_count: np.ndarray, null_emitted) -> np.ndarray:
+        k = len(keys)
+        if self.n + k > self.cap:
+            self._grow(k)
+        lo, hi = self.n, self.n + k
+        self.keys[lo:hi] = keys
+        self.ts[lo:hi] = ts
+        self.match_count[lo:hi] = match_count
+        self.null_emitted[lo:hi] = null_emitted
+        self.alive[lo:hi] = True
+        for col, v in zip(self.vals, vals):
+            col[lo:hi] = v
+        self.n = hi
+        return np.arange(lo, hi, dtype=np.int64)
+
+    def live_ids(self) -> np.ndarray:
+        return np.flatnonzero(self.alive[: self.n])
+
+    def kill(self, ids) -> None:
+        self.alive[ids] = False
+        self.n_dead += np.size(ids) if not isinstance(ids, (int, np.integer)) else 1
+        if self.n_dead > max(1024, self.n - self.n_dead):
+            self.compact()
+
+    def compact(self) -> None:
+        keep = self.live_ids()
+        m = len(keep)
+        self.keys[:m] = self.keys[keep]
+        self.ts[:m] = self.ts[keep]
+        self.match_count[:m] = self.match_count[keep]
+        self.null_emitted[:m] = self.null_emitted[keep]
+        for col in self.vals:
+            col[:m] = col[keep]
+        self.alive[:m] = True
+        self.alive[m: self.n] = False
+        self.n = m
+        self.n_dead = 0
 
 
 class JoinWithExpiration(Operator):
@@ -290,6 +476,12 @@ class JoinWithExpiration(Operator):
     ttl_micros (buffer retention, default 1 day). Outputs an updating stream
     (_is_retract column); outer sides emit (row, nulls) immediately and
     retract it when a first match arrives.
+
+    The buffering/probe hot path is columnar: appends probe the other
+    side's _SideStore with the shared sort/search join (host_join_indices)
+    and update match counts with one scatter-add; only retract rows — which
+    must locate one stored row by full value equality — walk rows in
+    Python, and they arrive rarely and in small numbers.
     """
 
     def __init__(self, cfg: dict):
@@ -297,8 +489,8 @@ class JoinWithExpiration(Operator):
         self.left_names: list[tuple[str, str]] = list(cfg["left_names"])
         self.right_names: list[tuple[str, str]] = list(cfg["right_names"])
         self.ttl = int(cfg.get("ttl_micros", 24 * 3600 * 1_000_000))
-        # per side: key-hash -> list[_StoredRow]
-        self.stores: tuple[dict, dict] = ({}, {})
+        self.stores: tuple[_SideStore, _SideStore] = (
+            _SideStore(len(self.left_names)), _SideStore(len(self.right_names)))
 
     def tables(self):
         return [
@@ -321,108 +513,152 @@ class JoinWithExpiration(Operator):
         for side, name in ((0, "left"), (1, "right")):
             tbl = ctx.table_manager.expiring_time_key(name, self.ttl)
             store = self.stores[side]
+            srcs = [src for _o, src in self._src_names(side)]
             for b in tbl.all_batches():
-                keys = b.keys.astype(np.uint64).view(np.int64)
-                srcs = [src for _o, src in self._src_names(side)]
-                mc = b["__match_count"]
-                ne = b["__null_emitted"].astype(bool)
-                for j in range(b.num_rows):
-                    row = _StoredRow(
-                        tuple(b[s][j] for s in srcs), int(b.timestamps[j]), int(keys[j])
-                    )
-                    row.match_count = int(mc[j])
-                    row.null_emitted = bool(ne[j])
-                    store.setdefault(int(keys[j]), []).append(row)
+                if b.num_rows == 0:
+                    continue
+                store.append(
+                    b.keys.astype(np.uint64).view(np.int64),
+                    b.timestamps,
+                    [_object_col(np.asarray(b[s])) for s in srcs],
+                    np.asarray(b["__match_count"], dtype=np.int64),
+                    np.asarray(b["__null_emitted"], dtype=bool),
+                )
             tbl.replace_all([])
 
     # ------------------------------------------------------------------
 
     def process_batch(self, batch, ctx, collector, input_index=0):
         side = ctx.edge_of_input(input_index)
-        other = 1 - side
         n = batch.num_rows
         keys = batch.keys.astype(np.uint64).view(np.int64)
         ts = batch.timestamps
         retracts = (
             np.asarray(batch[IS_RETRACT_FIELD], dtype=bool)
             if IS_RETRACT_FIELD in batch
-            else np.zeros(n, dtype=bool)
+            else None
         )
         srcs = [src for _o, src in self._src_names(side)]
         src_cols = [np.asarray(batch[s]) for s in srcs]
-        out_rows: list[tuple[tuple, tuple, int, bool]] = []  # (lvals, rvals, ts, retract)
-        my_store = self.stores[side]
-        other_store = self.stores[other]
-        for j in range(n):
-            k = int(keys[j])
-            vals = tuple(c[j] for c in src_cols)
-            t = int(ts[j])
-            matches = other_store.get(k, [])
-            if not retracts[j]:
-                row = _StoredRow(vals, t, k)
-                my_store.setdefault(k, []).append(row)
-                row.match_count = len(matches)
-                for m in matches:
-                    if m.match_count == 0 and m.null_emitted:
-                        # first match for an outer-side row: retract its nulls
-                        out_rows.append(self._pad(other, m.values, max(m.ts, t), True))
-                        m.null_emitted = False
-                    m.match_count += 1
-                    out_rows.append(self._pair(side, vals, m.values, max(m.ts, t), False))
-                if not matches and self._outer_for(side):
-                    out_rows.append(self._pad(side, vals, t, False))
-                    row.null_emitted = True
-            else:
-                # retract: remove the stored row with equal values
-                lst = my_store.get(k, [])
-                found = None
-                for i, r in enumerate(lst):
-                    if r.values == vals:
-                        found = i
-                        break
-                if found is None:
-                    raise RuntimeError(
-                        "retract for a row never seen (updating join ordering violation)"
-                    )
-                row = lst.pop(found)
-                if not lst:
-                    my_store.pop(k, None)
-                if row.null_emitted:
-                    out_rows.append(self._pad(side, vals, t, True))
+        out: list[tuple] = []  # emission segments, in order
+        if retracts is None or not retracts.any():
+            self._append_run(side, keys, ts, src_cols, out)
+        else:
+            # preserve in-batch ordering: vectorize each contiguous run of
+            # appends, walk retract rows one by one (they must locate one
+            # stored row by exact value equality)
+            edges = np.flatnonzero(np.diff(retracts)) + 1
+            for lo, hi in zip(np.r_[0, edges], np.r_[edges, n]):
+                lo, hi = int(lo), int(hi)
+                if retracts[lo]:
+                    for j in range(lo, hi):
+                        self._retract_row(
+                            side, int(keys[j]), int(ts[j]),
+                            tuple(c[j] for c in src_cols), out)
                 else:
-                    for m in matches:
-                        m.match_count -= 1
-                        out_rows.append(self._pair(side, vals, m.values, max(m.ts, t), True))
-                        if m.match_count == 0 and self._outer_for(other):
-                            out_rows.append(self._pad(other, m.values, max(m.ts, t), False))
-                            m.null_emitted = True
-        if out_rows:
-            self._emit(out_rows, collector)
+                    self._append_run(side, keys[lo:hi], ts[lo:hi],
+                                     [c[lo:hi] for c in src_cols], out)
+        if out:
+            self._emit(out, collector)
 
-    def _pair(self, side, vals, other_vals, ts, retract):
-        if side == 0:
-            return (vals, other_vals, ts, retract)
-        return (other_vals, vals, ts, retract)
+    def _append_run(self, side: int, keys, ts, src_cols, out: list) -> None:
+        """Vectorized append path: probe the other side once, scatter-add
+        match counts, emit pairs/pads as columnar segments."""
+        other = self.stores[1 - side]
+        mine = self.stores[side]
+        live = other.live_ids()
+        if len(live):
+            bi, oi = _hash_join_indices(keys, other.keys[live])
+            oid = live[oi]
+        else:
+            bi = oid = np.empty(0, dtype=np.int64)
+        counts = np.bincount(bi, minlength=len(keys)) if len(bi) else \
+            np.zeros(len(keys), dtype=np.int64)
+        new_ids = mine.append(keys, ts, src_cols, counts, False)
+        if len(oid):
+            # store rows seeing their FIRST match: retract their null pads.
+            # pairs are ordered by probe row asc, so the first occurrence of
+            # a store id carries the earliest matching row's timestamp
+            uniq, first = np.unique(oid, return_index=True)
+            newly = (other.match_count[uniq] == 0) & other.null_emitted[uniq]
+            if newly.any():
+                ids = uniq[newly]
+                pad_ts = np.maximum(other.ts[ids], ts[bi[first[newly]]])
+                out.append(self._pad_seg(1 - side,
+                                         [c[ids] for c in other.vals],
+                                         pad_ts, True))
+                other.null_emitted[ids] = False
+            np.add.at(other.match_count, oid, 1)
+            pair_ts = np.maximum(other.ts[oid], ts[bi])
+            out.append(self._pair_seg(side, [c[bi] for c in src_cols],
+                                      [c[oid] for c in other.vals],
+                                      pair_ts, False))
+        if self._outer_for(side):
+            unmatched = counts == 0
+            if unmatched.any():
+                out.append(self._pad_seg(side, [c[unmatched] for c in src_cols],
+                                         ts[unmatched], False))
+                mine.null_emitted[new_ids[unmatched]] = True
 
-    def _pad(self, side, vals, ts, retract):
-        if side == 0:
-            return (vals, None, ts, retract)
-        return (None, vals, ts, retract)
+    def _retract_row(self, side: int, k: int, t: int, vals: tuple,
+                     out: list) -> None:
+        mine = self.stores[side]
+        other = self.stores[1 - side]
+        found = None
+        for gid in np.flatnonzero(
+                (mine.keys[: mine.n] == k) & mine.alive[: mine.n]).tolist():
+            if all(v == mine.vals[i][gid] for i, v in enumerate(vals)):
+                found = gid
+                break
+        if found is None:
+            raise RuntimeError(
+                "retract for a row never seen (updating join ordering violation)"
+            )
+        null_emitted = bool(mine.null_emitted[found])
+        mine.kill(found)
+        row_vals = [_object_col([v]) for v in vals]
+        if null_emitted:
+            out.append(self._pad_seg(side, row_vals,
+                                     np.array([t], dtype=np.int64), True))
+            return
+        m = other.live_ids()
+        m = m[other.keys[m] == k]
+        if len(m):
+            other.match_count[m] -= 1
+            pair_ts = np.maximum(other.ts[m], t)
+            out.append(self._pair_seg(
+                side, [c.repeat(len(m)) for c in row_vals],
+                [c[m] for c in other.vals], pair_ts, True))
+            if self._outer_for(1 - side):
+                renull = m[other.match_count[m] == 0]
+                if len(renull):
+                    out.append(self._pad_seg(
+                        1 - side, [c[renull] for c in other.vals],
+                        np.maximum(other.ts[renull], t), False))
+                    other.null_emitted[renull] = True
 
-    def _emit(self, out_rows, collector) -> None:
-        n = len(out_rows)
+    def _pair_seg(self, side, my_vals, other_vals, ts, retract):
+        lv, rv = (my_vals, other_vals) if side == 0 else (other_vals, my_vals)
+        return (lv, rv, ts, retract, len(ts))
+
+    def _pad_seg(self, side, vals, ts, retract):
+        lv, rv = (vals, None) if side == 0 else (None, vals)
+        return (lv, rv, ts, retract, len(ts))
+
+    def _emit(self, segments: list, collector) -> None:
         cols: dict[str, np.ndarray] = {}
-        n_l = len(self.left_names)
         for i, (out_name, _src) in enumerate(self.left_names):
-            cols[out_name] = _object_col(
-                [lv[i] if lv is not None else None for lv, _r, _t, _x in out_rows]
-            )
+            cols[out_name] = np.concatenate(
+                [lv[i] if lv is not None else _null_col(k)
+                 for lv, _rv, _t, _r, k in segments])
         for i, (out_name, _src) in enumerate(self.right_names):
-            cols[out_name] = _object_col(
-                [rv[i] if rv is not None else None for _l, rv, _t, _x in out_rows]
-            )
-        cols[IS_RETRACT_FIELD] = np.array([r for _l, _r, _t, r in out_rows], dtype=bool)
-        cols[TIMESTAMP_FIELD] = np.array([t for _l, _r, t, _x in out_rows], dtype=np.int64)
+            cols[out_name] = np.concatenate(
+                [rv[i] if rv is not None else _null_col(k)
+                 for _lv, rv, _t, _r, k in segments])
+        cols[IS_RETRACT_FIELD] = np.concatenate(
+            [np.full(k, r) for _lv, _rv, _t, r, k in segments])
+        cols[TIMESTAMP_FIELD] = np.concatenate(
+            [np.asarray(t, dtype=np.int64) for _lv, _rv, t, _r, k in segments])
         collector.collect(Batch(cols))
 
     # ------------------------------------------------------------------
@@ -433,17 +669,16 @@ class JoinWithExpiration(Operator):
         cutoff = watermark.value - self.ttl
         oldest = None
         for store in self.stores:
-            dead_keys = []
-            for k, lst in store.items():
-                lst[:] = [r for r in lst if r.ts >= cutoff]
-                if not lst:
-                    dead_keys.append(k)
-                else:
-                    for r in lst:
-                        if oldest is None or r.ts < oldest:
-                            oldest = r.ts
-            for k in dead_keys:
-                del store[k]
+            live = store.live_ids()
+            if not len(live):
+                continue
+            expired = live[store.ts[live] < cutoff]
+            if len(expired):
+                store.kill(expired)
+                live = store.live_ids()
+            if len(live):
+                lo = int(store.ts[live].min())
+                oldest = lo if oldest is None else min(oldest, lo)
         # future emissions carry ts = max(sides) >= the oldest buffered row;
         # hold the watermark to that bound so downstream never sees late rows
         held = watermark.value if oldest is None else min(watermark.value, oldest)
@@ -455,19 +690,19 @@ class JoinWithExpiration(Operator):
         for side, name in ((0, "left"), (1, "right")):
             tbl = ctx.table_manager.expiring_time_key(name, self.ttl)
             store = self.stores[side]
-            rows = [r for lst in store.values() for r in lst]
-            if not rows:
+            live = store.live_ids()
+            if not len(live):
                 tbl.replace_all([])
                 continue
             srcs = [src for _o, src in self._src_names(side)]
             cols: dict[str, np.ndarray] = {
-                TIMESTAMP_FIELD: np.array([r.ts for r in rows], dtype=np.int64),
-                KEY_FIELD: np.array([r.key for r in rows], dtype=np.int64).view(np.uint64),
-                "__match_count": np.array([r.match_count for r in rows], dtype=np.int64),
-                "__null_emitted": np.array([r.null_emitted for r in rows], dtype=bool),
+                TIMESTAMP_FIELD: store.ts[live].copy(),
+                KEY_FIELD: store.keys[live].copy().view(np.uint64),
+                "__match_count": store.match_count[live].copy(),
+                "__null_emitted": store.null_emitted[live].copy(),
             }
             for i, s in enumerate(srcs):
-                cols[s] = _object_col([r.values[i] for r in rows])
+                cols[s] = store.vals[i][live]
             tbl.replace_all([Batch(cols)])
 
 

@@ -23,6 +23,56 @@ import numpy as np
 _SENTINEL = np.iinfo(np.int64).max
 
 
+def host_join_indices(
+    left_keys: np.ndarray, right_keys: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host (numpy) inner-join row index pairs (li, ri) where keys match:
+    sort the right side once, binary-search each left key, expand ranges.
+    The same sort/search phase the device path runs via _probe_jit."""
+    order = np.argsort(right_keys, kind="stable")
+    rk = right_keys[order]
+    lo = np.searchsorted(rk, left_keys, side="left")
+    hi = np.searchsorted(rk, left_keys, side="right")
+    counts = hi - lo
+    li = np.repeat(np.arange(len(left_keys)), counts)
+    # for each left row, offsets lo[l]..hi[l] into the sorted right
+    if len(li):
+        within = np.arange(len(li)) - np.repeat(
+            np.cumsum(counts) - counts, counts
+        )
+        ri = order[np.repeat(lo, counts) + within]
+    else:
+        ri = np.empty(0, dtype=np.int64)
+    return li, ri
+
+
+def fused_join_indices(
+    left_keys: np.ndarray,
+    right_keys: np.ndarray,
+    l_bounds: np.ndarray,
+    r_bounds: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Inner-join pairs for W independent partitions (windows) in one call:
+    partition w spans left rows l_bounds[w]:l_bounds[w+1] and right rows
+    r_bounds[w]:r_bounds[w+1]. Each partition is probed with the shared
+    sort/search join on its slice (still a Python loop over W — a true
+    (partition, key) lexsort probe is a possible follow-up); the win is in
+    the OUTPUT: pairs come back as GLOBAL row indices so the caller
+    gathers and emits once for all windows instead of W tiny batches."""
+    lis: list[np.ndarray] = []
+    ris: list[np.ndarray] = []
+    for w in range(len(l_bounds) - 1):
+        l0, l1 = int(l_bounds[w]), int(l_bounds[w + 1])
+        r0, r1 = int(r_bounds[w]), int(r_bounds[w + 1])
+        li, ri = host_join_indices(left_keys[l0:l1], right_keys[r0:r1])
+        if len(li):
+            lis.append(li + l0)
+            ris.append(ri + r0)
+    if not lis:
+        return (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64))
+    return np.concatenate(lis), np.concatenate(ris)
+
+
 @functools.lru_cache(maxsize=1)
 def _probe_jit():
     # one jitted callable; jax specializes per bucketed input shape

@@ -275,10 +275,13 @@ class SlidingAggregate(Operator):
         return ok
 
     def _drain(self, collector, force: bool = False) -> None:
-        """Emit in-order every window whose bins are all resolved, then
-        forward watermarks whose windows are out."""
+        """Emit in-order every window whose bins are all resolved — fused
+        into ONE output batch per drain (tail closes and catch-up used to
+        emit one tiny batch per window) — then forward watermarks whose
+        windows are out."""
         from ..ops.aggregate import combine_by_key
 
+        fused: list[dict] = []
         while not self._caught_up():
             w = self.next_window
             # event-time gap fast-forward: if no bin anywhere could feed a
@@ -305,11 +308,12 @@ class SlidingAggregate(Operator):
                 accs = [np.concatenate([p[1][i] for p in parts])
                         for i in range(len(self.acc_kinds))]
                 keys_c, accs_c = combine_by_key(self.acc_kinds, keys, accs)
-                self._emit_window(w, keys_c, accs_c, collector)
+                fused.append(self._window_cols(w, keys_c, accs_c))
             self.next_window = w + 1
             for b in [b for b in self._bin_cache if b < self.next_window]:
                 del self._bin_cache[b]
             self.key_dict.evict_closed(self.next_window)
+        self._emit_fused(fused, collector)
         while self._wm_queue and (self.next_window is None
                                   or self._wm_queue[0][0] < self.next_window):
             _t, wm = self._wm_queue.pop(0)
@@ -319,10 +323,12 @@ class SlidingAggregate(Operator):
 
     def _emit_through(self, last_start_rel: int, collector) -> None:
         """numpy-backend path: synchronous scan per window (the dict store
-        has no fetch latency to hide)."""
+        has no fetch latency to hide); all closing windows fuse into one
+        emitted batch."""
         if self.next_window is None:
             return
         agg = self._aggregator()
+        fused: list[dict] = []
         while self.next_window <= last_start_rel:
             b = self.next_window
             if self.max_bin is not None and b > self.max_bin:
@@ -345,15 +351,18 @@ class SlidingAggregate(Operator):
                 from ..ops.aggregate import combine_by_key
 
                 keys_c, accs_c = combine_by_key(self.acc_kinds, keys, accs)
-                self._emit_window(b, keys_c, accs_c, collector)
+                fused.append(self._window_cols(b, keys_c, accs_c))
             self.next_window = b + 1
             # bins below the next window's range are done
             agg.free_bins_below(self.next_window)
             self.key_dict.evict_closed(self.next_window)
             if self.min_bin is not None:
                 self.min_bin = max(self.min_bin, self.next_window)
+        self._emit_fused(fused, collector)
 
-    def _emit_window(self, start_rel: int, keys, accs, collector) -> None:
+    def _window_cols(self, start_rel: int, keys, accs) -> dict:
+        """Pre-projection output columns for one closed window (key lookups
+        resolved eagerly, BEFORE the caller evicts the window's keys)."""
         from ..ops.aggregate import finalize_aggs
 
         start = (start_rel + self.base_bin) * self.slide
@@ -371,9 +380,24 @@ class SlidingAggregate(Operator):
             cols[name] = arr
         # reference stamps the window start as the output event time (:217)
         cols[TIMESTAMP_FIELD] = np.full(n, start, dtype=np.int64)
+        return cols
+
+    def _emit_fused(self, fused: list[dict], collector) -> None:
+        """One collect for ALL windows closed in this drain: concatenate the
+        per-window columns, apply the final projection once (row-wise, so
+        fusing cannot change its values)."""
+        if not fused:
+            return
+        if len(fused) == 1:
+            cols = fused[0]
+        else:
+            names = fused[0].keys()
+            cols = {f: np.concatenate([c[f] for c in fused]) for f in names}
         out = Batch(cols)
         if self.final_projection is not None:
-            proj = {name: eval_expr(e, out.columns, n) for name, e in self.final_projection}
+            n = out.num_rows
+            proj = {name: eval_expr(e, out.columns, n)
+                    for name, e in self.final_projection}
             if TIMESTAMP_FIELD not in proj:
                 proj[TIMESTAMP_FIELD] = out.timestamps
             out = Batch(proj)

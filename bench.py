@@ -295,7 +295,10 @@ def oracle_q8(event_count):
 def run_config(name, build, backend, event_count, batch_size, queue_mult=2):
     from arroyo_tpu import config as cfg
     from arroyo_tpu.engine import run_graph
+    from arroyo_tpu.metrics import registry
 
+    # fresh histograms per run: the coalesce breakdown reports THIS rep
+    registry.clear_job(f"bench-{name}-{backend}")
     # queue depth sweep (r5, CPU): 2x batch beats 4x on every config
     # (less cache-cold buffering); q8 runs 1x — watermark-to-emit latency
     # is queue-transit bound and the join tolerates the shallower pipeline
@@ -312,6 +315,23 @@ def run_config(name, build, backend, event_count, batch_size, queue_mult=2):
     run_graph(g, job_id=f"bench-{name}-{backend}", timeout=1800)
     wall = time.perf_counter() - t0
     return wall, rows, latency_log, arrival_walls
+
+
+def coalesce_breakdown(job_id):
+    """Aggregate the coalescing histograms (emit-batch rows, queue-transit
+    seconds) across every task of one job (last rep: run_config clears)."""
+    from arroyo_tpu.metrics import (EMIT_ROWS_BUCKETS, TRANSIT_BUCKETS,
+                                    Histogram, registry)
+
+    em, qt = Histogram(EMIT_ROWS_BUCKETS), Histogram(TRANSIT_BUCKETS)
+    for t in registry.snapshot():
+        if t.job_id != job_id:
+            continue
+        for agg, h in ((em, t.emit_batch_rows), (qt, t.queue_transit)):
+            agg.counts = [a + b for a, b in zip(agg.counts, h.counts)]
+            agg.count += h.count
+            agg.sum += h.sum
+    return em, qt
 
 
 def latency_percentiles(rows, latency_log, arrival_walls, window_end_of):
@@ -522,10 +542,21 @@ def main() -> None:
                 best_eps, best_lat = eps, (p50, p99)
             if p99 is not None and (worst_p99 is None or p99 > worst_p99):
                 worst_p99 = p99
+        em, qt = coalesce_breakdown(f"bench-{name}-jax")
+        print(f"# {name} coalesce: {em.count} emitted batches, "
+              f"mean {em.mean():,.0f} rows/batch; queue transit "
+              f"p50 {qt.quantile(0.5) * 1000:.2f}ms "
+              f"p99 {qt.quantile(0.99) * 1000:.2f}ms ({qt.count} transits)",
+              file=sys.stderr)
         extra[name] = {
             "events_per_sec": round(best_eps, 1),
             "p50_ms": best_lat[0] and round(best_lat[0], 2),
             "p99_ms": best_lat[1] and round(best_lat[1], 2),
+            "coalesce": {
+                "emitted_batches": em.count,
+                "mean_emit_rows": round(em.mean(), 1),
+                "queue_transit_p99_ms": round(qt.quantile(0.99) * 1000, 3),
+            },
         }
         budget = P99_BUDGET_MS.get(name)
         if budget is not None:

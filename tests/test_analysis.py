@@ -408,6 +408,29 @@ def test_lr106_fault_site_coverage():
     assert "LR106" not in ids_of(lint_source(uncovered, "arroyo_tpu/utils/x.py"))
 
 
+def test_lr107_emit_in_loop():
+    bad = (
+        "def on_close(self, ctx, collector):\n"
+        "    for w in self.windows:\n"
+        "        collector.collect(self.window_batch(w))\n"
+    )
+    assert "LR107" in ids_of(lint_source(bad, "arroyo_tpu/operators/x.py"))
+    assert "LR107" in ids_of(lint_source(bad, "arroyo_tpu/windows/x.py"))
+    # connectors are out of scope: a source's poll loop IS its emit contract
+    assert "LR107" not in ids_of(lint_source(bad, "arroyo_tpu/connectors/x.py"))
+    fused = (
+        "def on_close(self, ctx, collector):\n"
+        "    parts = [self.window_cols(w) for w in self.windows]\n"
+        "    collector.collect(concat(parts))\n"
+    )
+    assert "LR107" not in ids_of(lint_source(fused, "arroyo_tpu/operators/x.py"))
+    waived = bad.replace(
+        "collector.collect(self.window_batch(w))",
+        "collector.collect(self.window_batch(w))  "
+        "# lint: waive LR107 — windows carry incompatible schemas")
+    assert "LR107" not in ids_of(lint_source(waived, "arroyo_tpu/operators/x.py"))
+
+
 def test_waivers():
     bad = (
         "def f():\n"

@@ -406,7 +406,9 @@ def test_instant_join_device_backend_end_to_end():
     windows + watermarks, matches the numpy backend exactly."""
     from arroyo_tpu import config as cfg
 
-    cfg.update({"device.join-min-rows": 0})
+    # force-device-join forces the device dispatch even though the test jax
+    # platform IS the host cpu (where the adaptive gate prefers numpy)
+    cfg.update({"device.join-min-rows": 0, "device.force-device-join": True})
     rng = np.random.default_rng(23)
 
     def run(backend):

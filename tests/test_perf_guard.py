@@ -1,0 +1,83 @@
+"""Microbench guard (slow): scaled-down q8/q5 through the full engine.
+
+Asserts three things the coalescing work must keep true:
+  - exact parity against the bench oracles (scaled event counts),
+  - a VERY conservative events/s sanity floor (an order of magnitude under
+    the measured numbers on the slowest box, so only a catastrophic
+    regression — not scheduler noise — can trip it),
+  - a ceiling on the number of emitted sink batches: accidental
+    de-coalescing (per-window or per-key tiny emits sneaking back into the
+    emission path) multiplies the batch count long before it shows up in
+    wall-clock numbers.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run(build, events, batch_size, queue_mult):
+    import bench
+
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.engine import run_graph
+
+    cfg.update({
+        "pipeline.chaining.enabled": True,
+        "pipeline.source-batch-size": batch_size,
+        "device.batch-capacity": batch_size,
+        "worker.queue-size": queue_mult * batch_size,
+    })
+    rows: list = []
+    g = build(rows, "jax", events, [], [])
+    t0 = time.perf_counter()
+    run_graph(g, job_id="perf-guard", timeout=600)
+    return time.perf_counter() - t0, rows
+
+
+def test_q8_scaled_parity_throughput_and_batch_count(_storage):
+    import bench
+
+    events, batch = 120_000, 8192
+    wall, rows = _run(bench.build_q8, events, batch, 1)
+    n_rows = bench.check_parity_q8(rows, events)
+    assert n_rows > 0
+    eps = events / wall
+    assert eps > 60_000, f"q8 catastrophically slow: {eps:,.0f} ev/s"
+    # 120k events at 100us spacing = 12s = 2 windows; the fused close +
+    # coalescing emit one batch per window close (plus slack for the
+    # boundary). Per-window de-coalescing would multiply this count.
+    n_windows = len({(ts // bench.WIDTH) for b in rows
+                     for ts in np.asarray(b["_timestamp"]).tolist()})
+    assert len(rows) <= 4 * n_windows + 8, (
+        f"{len(rows)} sink batches for {n_windows} windows: emission path "
+        f"is de-coalesced")
+
+
+def test_q5_scaled_parity_throughput_and_batch_count(_storage):
+    import bench
+
+    events, batch = 200_000, 8192
+    wall, rows = _run(bench.build_q5, events, batch, 2)
+    total = bench.check_parity_q5(rows, events)
+    assert total > 0
+    eps = events / wall
+    assert eps > 60_000, f"q5 catastrophically slow: {eps:,.0f} ev/s"
+    n_windows = len({ws for b in rows
+                     for ws in np.asarray(b["window_start"]).tolist()})
+    # fused drain emits at most one batch per watermark-driven close round;
+    # well under one batch per window once fusing + coalescing work
+    assert len(rows) <= 2 * n_windows + 8, (
+        f"{len(rows)} sink batches for {n_windows} windows: emission path "
+        f"is de-coalesced")
+    mean_rows = sum(b.num_rows for b in rows) / len(rows)
+    assert mean_rows >= 64, f"mean emit batch of {mean_rows:.0f} rows"

@@ -3,7 +3,7 @@
 
 Monkey-patches timing wrappers around the hot-path stages (source
 generation, value/key operators, slot-aggregate update, window close
-dispatch/fetch, emission) and runs bench.run_once. Nested keys overlap:
+dispatch/fetch, emission) and runs bench.run_config. Nested keys overlap:
 agg_process_total includes agg_update_chunk, which includes dir_lookup.
 
 Usage:
@@ -75,11 +75,12 @@ def main() -> None:
     wrap(sa.SlotExtractHandle, "result", "close_fetch_materialize")
     wrap(tw.TumblingAggregate, "_emit_entries", "emit_entries")
 
-    bench.run_once("jax", 50_000, batch_size=batch)  # compile warmup
+    bench.run_config("q7", bench.build_q7, "jax", 50_000, batch)  # warmup
     T.clear()
     C.clear()
-    wall, n, _rows = bench.run_once("jax", events, batch_size=batch)
-    print(f"\n{n} events in {wall:.2f}s = {n / wall:,.0f} ev/s")
+    wall, _rows, _lat, _walls = bench.run_config(
+        "q7", bench.build_q7, "jax", events, batch)
+    print(f"\n{events} events in {wall:.2f}s = {events / wall:,.0f} ev/s")
     for k, v in sorted(T.items(), key=lambda kv: -kv[1]):
         print(f"  {k:26s} {v * 1000:8.1f} ms   x{C[k]}")
 
