@@ -33,6 +33,13 @@ Rule catalog:
                                across iterations and emit once (the
                                coalescing layer smooths queue transits, but
                                cannot remove per-collect routing work)
+    LR108 bare-print           ``print()`` in arroyo_tpu/ library code
+                               (outside cli.py/__main__.py): worker stdout
+                               IS the JSON-lines control protocol, so a
+                               stray print corrupts controller event
+                               parsing — and it bypasses the configured
+                               logging format/level; route through
+                               ``logging.getLogger(...)``
 
 Waivers: append ``# lint: waive LR1xx — justification`` on the flagged
 line (or the line above). A waiver with no justification text does not
@@ -399,6 +406,29 @@ def rule_lr107(mod: ModuleInfo) -> Iterable[Finding]:
                        "closes), or waive with justification")
 
 
+def rule_lr108(mod: ModuleInfo) -> Iterable[Finding]:
+    """Bare print() in library code. A worker subprocess's stdout is the
+    JSON-lines wire protocol to the controller (scheduler.py docstring):
+    a print from engine/operator/connector code interleaves garbage into
+    the event stream (the reader skips unparseable lines, silently losing
+    the message). CLI entry points (cli.py, __main__.py) own their stdout
+    and are exempt; bench.py and tools/ live outside the package."""
+    if not mod.relpath.startswith("arroyo_tpu/"):
+        return
+    if mod.relpath in ("arroyo_tpu/cli.py", "arroyo_tpu/__main__.py"):
+        return
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "print":
+            yield (n.lineno,
+                   "bare print() in library code: worker stdout is the "
+                   "JSON-lines control protocol (a stray line corrupts "
+                   "controller event parsing) and prints bypass the "
+                   "configured logging format/level",
+                   "route through logging.getLogger('arroyo_tpu...') — or "
+                   "waive with justification for genuinely CLI-owned output")
+
+
 RULES: tuple[tuple[str, Severity, object], ...] = (
     ("LR101", Severity.ERROR, rule_lr101),
     ("LR102", Severity.ERROR, rule_lr102),
@@ -407,6 +437,7 @@ RULES: tuple[tuple[str, Severity, object], ...] = (
     ("LR105", Severity.ERROR, rule_lr105),
     ("LR106", Severity.ERROR, rule_lr106),
     ("LR107", Severity.ERROR, rule_lr107),
+    ("LR108", Severity.ERROR, rule_lr108),
 )
 
 # fault sites every full-package lint must find wired (mirrors faults.SITES;

@@ -106,6 +106,18 @@ class ArroyoClient:
     def job_metrics(self, job_id: str) -> dict:
         return self._req("GET", f"/api/v1/jobs/{job_id}/metrics")
 
+    def job_traces(self, job_id: str, epoch: "Optional[int]" = None,
+                   raw_events: bool = False) -> dict:
+        """Checkpoint epoch traces: Chrome trace-event JSON by default,
+        or the raw span events with raw_events=True."""
+        q = []
+        if epoch is not None:
+            q.append(f"epoch={epoch}")
+        if raw_events:
+            q.append("format=events")
+        suffix = f"?{'&'.join(q)}" if q else ""
+        return self._req("GET", f"/api/v1/jobs/{job_id}/traces{suffix}")
+
     def list_connectors(self) -> dict:
         return self._req("GET", "/api/v1/connectors")
 

@@ -105,6 +105,7 @@ class ApiServer:
         ("GET", r"^/api/v1/jobs/([^/]+)/checkpoints$", "_job_checkpoints"),
         ("GET", r"^/api/v1/jobs/([^/]+)/output$", "_job_output"),
         ("GET", r"^/api/v1/jobs/([^/]+)/metrics$", "_job_metrics"),
+        ("GET", r"^/api/v1/jobs/([^/]+)/traces$", "_job_traces"),
         ("GET", r"^/api/v1/connectors$", "_connectors"),
         ("POST", r"^/api/v1/connection_profiles$", "_create_profile"),
         ("GET", r"^/api/v1/connection_profiles$", "_list_profiles"),
@@ -403,6 +404,34 @@ class ApiServer:
             q = parse_qs(h.path.split("?", 1)[1])
             after = int(q.get("after", ["-1"])[0])
         h._json(200, {"data": self.db.list_outputs(jid, after_seq=after)})
+
+    def _job_traces(self, h, jid):
+        """Epoch-lifecycle traces (obs.trace): Chrome trace-event JSON by
+        default (loads directly in chrome://tracing / Perfetto's legacy-UI
+        importer); ``?format=events`` returns the raw span events (the
+        `trace --report` CLI renders timelines from these); ``?epoch=N``
+        restricts either form to one epoch."""
+        from urllib.parse import parse_qs
+
+        from ..obs import trace as obs_trace
+
+        q = parse_qs(h.path.split("?", 1)[1]) if "?" in h.path else {}
+        epoch = int(q["epoch"][0]) if q.get("epoch") else None
+        # DB-persisted rows (written by the controller) cover every
+        # scheduler; the in-process recorder — when this process has one for
+        # the job — is always at least as complete (DB rows are snapshots of
+        # it taken at checkpoint-complete time, before late commit spans), so
+        # recorder events win per epoch
+        rows = self.db.list_traces(jid, epoch=epoch)
+        by_epoch = {r["epoch"]: r["events"] for r in rows}
+        for e in obs_trace.recorder.epochs(jid):
+            if epoch is None or e == epoch:
+                by_epoch[e] = obs_trace.recorder.events(jid, e)
+        if q.get("format", [""])[0] == "events":
+            h._json(200, {"job_id": jid, "epochs": {
+                str(e): evs for e, evs in sorted(by_epoch.items())}})
+            return
+        h._json(200, obs_trace.chrome_trace(jid, by_epoch))
 
     def _job_metrics(self, h, jid):
         # DB-persisted snapshots (shipped from workers over the control

@@ -224,6 +224,10 @@ def _cmd_worker(args) -> int:
         worker_index=int(getattr(args, "worker_index", None) or 0),
         network=network,
     )
+    # relay epoch-lifecycle span events to the controller so ITS trace
+    # recorder (the one behind /traces and the wedge diagnostics) holds
+    # this worker's barrier/snapshot/ack timeline too
+    eng.relay_spans = True
     if n_workers > 1:
         emit({"event": "started", "dp_port": network.port,
               "worker_index": int(args.worker_index or 0)})
@@ -279,6 +283,15 @@ def _cmd_worker(args) -> int:
                     and len(eng._finished_tasks) + len(eng._failed) >= eng._n_tasks)
             completed = sorted(eng._completed_epochs - reported)
             failed = list(eng._failed)
+        # spans first: a subtask_acked that completes global coverage makes
+        # the controller compute phase durations and persist the epoch trace
+        # immediately, so the ack span enqueued alongside it must already be
+        # in the recorder by then
+        while True:
+            try:
+                emit(eng.span_events.get_nowait())
+            except _queue_mod.Empty:
+                break
         if eng.coordinated:
             # relay per-subtask acks; the controller declares epochs done
             while True:
@@ -315,6 +328,103 @@ def _cmd_worker(args) -> int:
                 emit({"event": "metrics", "data": _mreg.job_metrics(args.job_id)})
             last_hb = time.monotonic()
         time.sleep(0.05)
+
+
+def _cmd_trace(args) -> int:
+    """Export a job's epoch-lifecycle traces (obs.trace): Chrome
+    trace-event JSON (open in chrome://tracing or Perfetto's legacy-UI
+    importer) or, with --report, human-readable per-epoch timelines naming
+    any stuck subtask. Reads the controller DB directly (--db) or the
+    cluster API (--api)."""
+    import urllib.request
+
+    from arroyo_tpu.obs import trace as obs_trace
+
+    if args.db:
+        from arroyo_tpu.controller import Database
+
+        db = Database(args.db)
+        rows = db.list_traces(args.job_id, epoch=args.epoch)
+        by_epoch = {r["epoch"]: r["events"] for r in rows}
+    else:
+        url = (f"{args.api.rstrip('/')}/api/v1/jobs/{args.job_id}"
+               "/traces?format=events")
+        if args.epoch is not None:
+            url += f"&epoch={args.epoch}"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            payload = json.load(r)
+        by_epoch = {int(e): evs
+                    for e, evs in (payload.get("epochs") or {}).items()}
+    if not by_epoch:
+        print(f"no trace events recorded for job {args.job_id}",
+              file=sys.stderr)
+        return 1
+    if args.report:
+        for e in sorted(by_epoch):
+            print(obs_trace.timeline_report(args.job_id, e, by_epoch[e]))
+        return 0
+    chrome = obs_trace.chrome_trace(args.job_id, by_epoch)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(chrome, f)
+        print(f"wrote {len(chrome['traceEvents'])} trace events to "
+              f"{args.out}", file=sys.stderr)
+    else:
+        print(json.dumps(chrome))
+    return 0
+
+
+def _cmd_top(args) -> int:
+    """Live per-operator job view from the controller DB: rows/s in/out,
+    backpressure, queue-transit p99, watermark lag, and the last epoch's
+    duration with its dominant checkpoint phase. Refreshes until the job
+    reaches a terminal state (--once prints a single frame)."""
+    import urllib.error
+    import urllib.request
+
+    from arroyo_tpu.obs import topview
+
+    db = None
+    if args.db:
+        from arroyo_tpu.controller import Database
+
+        db = Database(args.db)
+
+    def fetch():
+        if db is not None:
+            job = db.get_job(args.job_id)
+            if job is None:
+                return None, None, None
+            return (job, db.get_metrics(args.job_id),
+                    db.list_checkpoints(args.job_id))
+        base = args.api.rstrip("/")
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return json.load(r)
+
+        try:
+            job = get(f"/api/v1/jobs/{args.job_id}")
+        except urllib.error.HTTPError:
+            return None, None, None
+        metrics = get(f"/api/v1/jobs/{args.job_id}/metrics").get("data")
+        ckpts = get(f"/api/v1/jobs/{args.job_id}/checkpoints").get("data")
+        return job, metrics, ckpts
+
+    while True:
+        job, metrics, ckpts = fetch()
+        if job is None or "state" not in job:
+            print(f"job {args.job_id} not found", file=sys.stderr)
+            return 1
+        frame = topview.render(job, metrics, ckpts)
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        if job["state"] in ("Failed", "Finished", "Stopped"):
+            return 0
+        time.sleep(args.interval)
 
 
 def _cmd_node(args) -> int:
@@ -423,6 +533,35 @@ def main(argv: Optional[list[str]] = None) -> int:
     vp = sub.add_parser("visualize", help="print the dataflow graph as dot")
     vp.add_argument("sql_file")
     vp.set_defaults(fn=_cmd_visualize)
+
+    tp = sub.add_parser("trace", help="export a job's checkpoint-epoch "
+                                      "traces (Chrome trace-event JSON)")
+    tp.add_argument("job_id")
+    tp.add_argument("--api", default="http://127.0.0.1:5115",
+                    help="cluster API base url")
+    tp.add_argument("--db", default=None,
+                    help="read the controller DB file directly instead")
+    tp.add_argument("--epoch", type=int, default=None,
+                    help="restrict to one epoch")
+    tp.add_argument("--out", "-o", default=None,
+                    help="write the JSON here instead of stdout")
+    tp.add_argument("--report", action="store_true",
+                    help="print human-readable per-epoch timelines instead")
+    tp.set_defaults(fn=_cmd_trace)
+
+    op = sub.add_parser("top", help="live per-operator job view "
+                                    "(throughput, backpressure, watermark "
+                                    "lag, checkpoint phases)")
+    op.add_argument("job_id")
+    op.add_argument("--api", default="http://127.0.0.1:5115",
+                    help="cluster API base url")
+    op.add_argument("--db", default=None,
+                    help="read the controller DB file directly instead")
+    op.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period seconds")
+    op.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clearing)")
+    op.set_defaults(fn=_cmd_top)
 
     kp = sub.add_parser("check", help="static analysis of a SQL pipeline "
                                       "(plan + dataflow validation, no run)")

@@ -30,6 +30,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Optional, Sequence
 
+from ..obs.trace import recorder as trace_recorder
 from ..state.tables import write_job_checkpoint_metadata
 
 SubtaskKey = tuple[str, int]  # (node_id, subtask_index)
@@ -160,6 +161,7 @@ class CheckpointCoordinator:
                                | {k[0] for k in (self.finished & self.expected)})
         write_job_checkpoint_metadata(
             self.storage_url, self.job_id, st.epoch, {"operators": operators})
+        trace_recorder.record(self.job_id, st.epoch, "metadata_durable")
         with self._lock:
             self.pending.pop(st.epoch, None)
             self.durable.append(st.epoch)
@@ -185,6 +187,8 @@ class CheckpointCoordinator:
                     self.event_log.append(("commit_dropped", epoch, widx))
                 continue
             send(epoch)
+            trace_recorder.record(self.job_id, epoch, "commit_sent",
+                                  worker=widx)
             with self._lock:
                 self.event_log.append(("commit_sent", epoch, widx))
 

@@ -24,6 +24,7 @@ import traceback
 from typing import Optional
 
 from ..config import config
+from ..obs import trace as obs_trace
 from ..state.tables import latest_complete_checkpoint
 from .db import Database
 from .scheduler import Scheduler, WorkerHandle, scheduler_for
@@ -68,6 +69,10 @@ class JobController:
         # messages get overwritten by later recoveries)
         self.watchdog_failed_epochs = 0
         self.watchdog_escalations = 0
+        # latest per-operator metrics snapshot per worker of the set;
+        # merged (union by subtask label) before persisting, so no worker's
+        # report overwrites another's operators
+        self._metrics_by_worker: dict[int, dict] = {}
         from ..metrics import RateTracker
 
         self.rates = RateTracker(window_s=10.0)
@@ -248,9 +253,14 @@ class JobController:
             self.coordinator = CheckpointCoordinator(
                 self.job_id, self.storage_url, expected,
                 event_log=self.checkpoint_event_log)
-        # a fresh worker set starts a fresh checkpoint ledger
+        # a fresh worker set starts a fresh checkpoint ledger (and a fresh
+        # metrics view: the old set's counters restart from zero)
         self._inflight_epochs = {}
         self._ckpt_failures = 0
+        self._metrics_by_worker = {}
+        # stale RateTracker points against the old set's (larger) totals
+        # would make (new - old)/dt negative for a whole rate window
+        self.rates.reset()
         self.db.update_job(self.job_id, n_workers=len(self.handles))
         self.running_since = time.monotonic()
         self.last_checkpoint_time = time.monotonic()
@@ -266,6 +276,7 @@ class JobController:
         stuck-epoch watchdog."""
         if self.coordinator is not None:
             self.coordinator.begin(epoch)
+        obs_trace.recorder.record(self.job_id, epoch, "trigger")
         self._inflight_epochs[epoch] = time.monotonic()
         for h in self.handles:
             if h is not None:
@@ -279,12 +290,24 @@ class JobController:
         single workers self-commit inside the engine)."""
         self._inflight_epochs.pop(epoch, None)
         self._ckpt_failures = 0
-        self.db.record_checkpoint(self.job_id, epoch, "complete")
-        self.db.update_job(self.job_id, checkpoint_epoch=epoch)
+        obs_trace.recorder.record(self.job_id, epoch, "metadata_durable")
         if self.coordinator is not None:
             self.coordinator.send_commits(
                 epoch,
                 [h.send_commit if h is not None else None for h in self.handles])
+        # the epoch's span tree is as complete as it gets: derive the phase
+        # durations (align/snapshot/ack/commit), feed the histograms, and
+        # persist both to the DB for `top`/`trace` and the API
+        events = obs_trace.recorder.events(self.job_id, epoch)
+        phases = obs_trace.phase_durations(events)
+        if phases:
+            from ..metrics import registry as metrics_registry
+
+            metrics_registry.observe_epoch_phases(self.job_id, phases)
+        self.db.record_checkpoint(self.job_id, epoch, "complete",
+                                  phases=phases or None)
+        self.db.update_job(self.job_id, checkpoint_epoch=epoch)
+        self.db.record_trace(self.job_id, epoch, events)
         if self.state == JobState.CHECKPOINT_STOPPING and epoch == self.stopping_epoch:
             self._set_state(JobState.STOPPING)
         self._maybe_gc(epoch)
@@ -326,6 +349,26 @@ class JobController:
         self._gc_thread = threading.Thread(
             target=_run_gc, daemon=True, name=f"ckpt-gc-{self.job_id}")
         self._gc_thread.start()
+
+    def _record_worker_metrics(self, widx: int, data: dict) -> None:
+        """Merge one worker's per-operator snapshot into the job view (union
+        by subtask label — under an assignment each worker owns a disjoint
+        slice, so a 2-worker set's snapshot carries BOTH workers' subtasks),
+        refresh the windowed rates, and persist for the API/`top`."""
+        from ..metrics import merge_job_metrics
+
+        self._metrics_by_worker[widx] = data
+        merged = merge_job_metrics(self._metrics_by_worker.values())
+        now = time.monotonic()
+        for op, m in merged.items():
+            self.rates.observe(
+                f"{op}.sent", int(m.get("arroyo_worker_messages_sent", 0)), now)
+            self.rates.observe(
+                f"{op}.recv", int(m.get("arroyo_worker_messages_recv", 0)), now)
+            m["messages_per_sec"] = round(self.rates.rate(f"{op}.sent"), 2)
+            m["messages_recv_per_sec"] = round(self.rates.rate(f"{op}.recv"), 2)
+        if merged:
+            self.db.record_metrics(self.job_id, merged)
 
     def _on_worker_finished(self, widx: int, h: WorkerHandle, job: dict) -> bool:
         """One worker of the set drained. Returns True when the whole set
@@ -380,6 +423,7 @@ class JobController:
         escalation ended this supervision pass."""
         outstanding: list = []
         to_subsume: list[int] = []
+        wedge_report = ""
         for epoch in stuck:
             self._inflight_epochs.pop(epoch, None)
             if self.coordinator is not None:
@@ -395,6 +439,15 @@ class JobController:
             # over the emptied directory (silent state loss on restore); a
             # torn epoch without its marker is invisible anyway
             self.db.record_checkpoint(self.job_id, epoch, "failed")
+            # attach the epoch's trace timeline: the wedge diagnostic names
+            # the exact subtask whose barrier never arrived / never acked,
+            # and the persisted trace makes the postmortem queryable
+            events = obs_trace.recorder.events(self.job_id, epoch)
+            wedge_report = obs_trace.timeline_report(
+                self.job_id, epoch, events,
+                expected=self.coordinator.expected
+                if self.coordinator is not None else None)
+            self.db.record_trace(self.job_id, epoch, events)
             self._ckpt_failures += 1
             self.watchdog_failed_epochs += 1
         if to_subsume:
@@ -421,7 +474,8 @@ class JobController:
             self._on_worker_failed(
                 f"checkpoint wedged {self._ckpt_failures} consecutive times "
                 f"(last epoch {stuck[-1]}){detail}; restoring the worker set "
-                "from the last globally complete checkpoint", job)
+                "from the last globally complete checkpoint\n"
+                f"{wedge_report}", job)
             return True
         # retry at a FRESH epoch number (the wedged one is subsumed; late
         # acks for it are dropped by the coordinator)
@@ -460,14 +514,15 @@ class JobController:
                     self.db.record_output(self.job_id, ev.get("lines", []))
                 elif kind == "metrics":
                     data = ev.get("data") or {}
-                    now = time.monotonic()
-                    for op, m in data.items():
-                        self.rates.observe(
-                            f"{op}.sent", int(m.get("arroyo_worker_messages_sent", 0)), now
-                        )
-                        m["messages_per_sec"] = round(self.rates.rate(f"{op}.sent"), 2)
                     if data:
-                        self.db.record_metrics(self.job_id, data)
+                        self._record_worker_metrics(widx, data)
+                elif kind == "span":
+                    # a worker subprocess relayed an epoch-lifecycle span;
+                    # the controller's recorder holds the whole job timeline
+                    obs_trace.recorder.record(
+                        self.job_id, int(ev["epoch"]), ev["name"],
+                        ev.get("node"), ev.get("subtask"), ev.get("worker"),
+                        ev.get("t_us"))
                 elif kind == "checkpoint_completed":
                     if self.coordinator is not None:
                         continue  # coordinated sets: durability is decided HERE
@@ -608,6 +663,12 @@ class ControllerServer:
                 if final:
                     self.db.record_metrics(jid, final)
                 metrics_registry.clear_job(jid)
+                # flush every buffered epoch trace to the DB (postmortems
+                # via the API/`trace` CLI survive the recorder eviction)
+                for epoch in obs_trace.recorder.epochs(jid):
+                    self.db.record_trace(
+                        jid, epoch, obs_trace.recorder.events(jid, epoch))
+                obs_trace.recorder.clear_job(jid)
                 del self.jobs[jid]
                 continue
             jc.step()

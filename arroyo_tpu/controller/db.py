@@ -73,8 +73,16 @@ CREATE TABLE IF NOT EXISTS connection_tables (
 CREATE TABLE IF NOT EXISTS checkpoints (
     job_id TEXT NOT NULL,
     epoch INTEGER NOT NULL,
-    state TEXT NOT NULL,          -- 'inprogress' | 'complete' | 'compacted'
+    state TEXT NOT NULL,          -- 'inprogress' | 'complete' | 'compacted' | 'failed'
     time REAL NOT NULL,
+    phases TEXT,                  -- JSON {align,snapshot,ack,commit: seconds}
+    PRIMARY KEY (job_id, epoch)
+);
+CREATE TABLE IF NOT EXISTS job_traces (
+    job_id TEXT NOT NULL,
+    epoch INTEGER NOT NULL,
+    events TEXT NOT NULL,         -- JSON epoch-lifecycle span events
+    updated_at REAL NOT NULL,
     PRIMARY KEY (job_id, epoch)
 );
 CREATE TABLE IF NOT EXISTS job_outputs (
@@ -106,6 +114,7 @@ class Database:
             for migration in (
                 "ALTER TABLE jobs ADD COLUMN desired_parallelism INTEGER",
                 "ALTER TABLE jobs ADD COLUMN n_workers INTEGER NOT NULL DEFAULT 1",
+                "ALTER TABLE checkpoints ADD COLUMN phases TEXT",
             ):
                 try:
                     self._conn.execute(migration)
@@ -336,12 +345,18 @@ class Database:
 
     # ---------------------------------------------------------- checkpoints
 
-    def record_checkpoint(self, job_id: str, epoch: int, state: str) -> None:
+    def record_checkpoint(self, job_id: str, epoch: int, state: str,
+                          phases: Optional[dict] = None) -> None:
         with self._lock:
             self._conn.execute(
-                "INSERT INTO checkpoints (job_id, epoch, state, time) VALUES (?,?,?,?) "
-                "ON CONFLICT(job_id, epoch) DO UPDATE SET state=excluded.state, time=excluded.time",
-                (job_id, epoch, state, time.time()),
+                "INSERT INTO checkpoints (job_id, epoch, state, time, phases) "
+                "VALUES (?,?,?,?,?) "
+                "ON CONFLICT(job_id, epoch) DO UPDATE SET state=excluded.state, "
+                "time=excluded.time, "
+                # a later state-only update ('compacted') keeps the phases
+                "phases=COALESCE(excluded.phases, checkpoints.phases)",
+                (job_id, epoch, state, time.time(),
+                 json.dumps(phases) if phases else None),
             )
             self._conn.commit()
 
@@ -351,6 +366,43 @@ class Database:
                 "SELECT * FROM checkpoints WHERE job_id=? ORDER BY epoch", (job_id,)
             ).fetchall()
         return [dict(r) for r in rows]
+
+    _TRACE_CAP = 32  # newest epochs retained per job (mirrors the recorder)
+
+    def record_trace(self, job_id: str, epoch: int, events: list[dict]) -> None:
+        """Persist one epoch's lifecycle span events (obs.trace), bounded to
+        the newest _TRACE_CAP epochs per job."""
+        if not events:
+            return
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO job_traces (job_id, epoch, events, updated_at) "
+                "VALUES (?,?,?,?) ON CONFLICT(job_id, epoch) DO UPDATE SET "
+                "events=excluded.events, updated_at=excluded.updated_at",
+                (job_id, epoch, json.dumps(events), time.time()),
+            )
+            self._conn.execute(
+                "DELETE FROM job_traces WHERE job_id=? AND epoch NOT IN ("
+                "SELECT epoch FROM job_traces WHERE job_id=? "
+                "ORDER BY epoch DESC LIMIT ?)",
+                (job_id, job_id, self._TRACE_CAP),
+            )
+            self._conn.commit()
+
+    def list_traces(self, job_id: str,
+                    epoch: Optional[int] = None) -> list[dict]:
+        """[{epoch, events: [...]}] oldest epoch first."""
+        with self._lock:
+            if epoch is None:
+                rows = self._conn.execute(
+                    "SELECT epoch, events FROM job_traces WHERE job_id=? "
+                    "ORDER BY epoch", (job_id,)).fetchall()
+            else:
+                rows = self._conn.execute(
+                    "SELECT epoch, events FROM job_traces WHERE job_id=? "
+                    "AND epoch=?", (job_id, epoch)).fetchall()
+        return [{"epoch": int(r["epoch"]), "events": json.loads(r["events"])}
+                for r in rows]
 
     # -------------------------------------------------- preview output
 

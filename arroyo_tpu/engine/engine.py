@@ -39,6 +39,8 @@ from ..state.tables import (
     latest_complete_checkpoint,
     write_job_checkpoint_metadata,
 )
+from ..obs.trace import recorder as trace_recorder
+from ..obs.trace import now_us, timeline_report
 from ..types import CheckpointBarrier, ControlMessage, ControlResp, TaskInfo
 from .queues import TaskInbox
 from .task import Task
@@ -71,14 +73,19 @@ class CheckpointWait:
 
     outcome: str  # "completed" | "finished" | "timeout"
     missing: tuple = ()  # (node_id, subtask) pairs unacked at timeout
+    # timeout only: the epoch's trace timeline (obs.trace.timeline_report),
+    # naming the exact subtask whose barrier never arrived / never acked —
+    # a chaos failure asserting on this repr is self-diagnosing
+    report: str = ""
 
     def __bool__(self) -> bool:
         return self.outcome == "completed"
 
     def __repr__(self) -> str:
         if self.outcome == "timeout" and self.missing:
-            return (f"CheckpointWait(timeout, never acked: "
+            base = (f"CheckpointWait(timeout, never acked: "
                     f"{list(self.missing)})")
+            return f"{base}\n{self.report}" if self.report else base
         return f"CheckpointWait({self.outcome})"
 
 
@@ -159,6 +166,24 @@ class Engine:
         # set by _abort(): distinguishes a torn-down engine from a drained
         # one — an externally-killed worker must not report "finished"
         self._aborted = False
+        # epoch-lifecycle tracing: every engine records its subtasks' span
+        # events into the process-global recorder; a worker subprocess
+        # additionally relays them (relay_spans set by the worker CLI) so
+        # the CONTROLLER's recorder holds the whole job's timeline
+        self.relay_spans = False
+        self.span_events: "_queue.Queue[dict]" = _queue.Queue()
+
+    def _span(self, epoch: int, event: str, node: Optional[str] = None,
+              subtask: Optional[int] = None, worker: Optional[int] = None,
+              t_us: Optional[int] = None) -> None:
+        t = now_us() if t_us is None else int(t_us)
+        trace_recorder.record(self.job_id, epoch, event, node, subtask,
+                              worker, t)
+        if self.relay_spans:
+            self.span_events.put({
+                "event": "span", "epoch": epoch, "name": event, "node": node,
+                "subtask": subtask, "worker": worker, "t_us": t,
+            })
 
     # -------------------------------------------------------------- building
 
@@ -320,6 +345,19 @@ class Engine:
                     if len(self._finished_tasks) + len(self._failed) >= self._n_tasks and self._n_tasks:
                         return
                 continue
+            if resp.kind == "checkpoint_event" and resp.checkpoint_event:
+                ce = resp.checkpoint_event
+                name = {"started_alignment": "align_start",
+                        "started_checkpointing": "snapshot_start"}.get(
+                            ce.event_type)
+                if name:
+                    self._span(ce.checkpoint_epoch, name, node=resp.node_id,
+                               subtask=resp.subtask_index,
+                               t_us=ce.time_micros)
+                continue
+            if resp.kind == "checkpoint_completed":
+                self._span(resp.epoch, "ack", node=resp.node_id,
+                           subtask=resp.subtask_index)
             with self._lock:
                 key = (resp.node_id, resp.subtask_index)
                 if resp.kind == "task_finished":
@@ -375,6 +413,7 @@ class Engine:
                     self.storage_url, self.job_id, epoch,
                     {"operators": list({k[0] for k in ep})},
                 )
+                self._span(epoch, "metadata_durable")
                 self._completed_epochs.add(epoch)
                 # two-phase commit: metadata is durable, tell committing
                 # sinks to finalize (reference send_commit_messages,
@@ -387,6 +426,7 @@ class Engine:
                         task.control_queue.put(
                             ControlMessage(kind="commit", epoch=epoch)
                         )
+                self._span(epoch, "commit_delivered", worker=self.worker_index)
 
     def deliver_commit(self, epoch: int) -> None:
         """Phase-2 entry point in assignment mode: the control plane calls
@@ -407,11 +447,13 @@ class Engine:
             # them — an epoch the watchdog subsumed (and nobody acked here)
             # must not surface as "completed" to compact()/cleanup() callers
             self._completed_epochs.add(epoch)
+            delivered = []
             for e in sorted(self._checkpoints):
                 if not (lo < e <= epoch):
                     continue
                 self._completed_epochs.add(e)
                 self.delivered_commits.append(e)
+                delivered.append(e)
                 for key, task in self.tasks.items():
                     if key not in self._checkpoints[e] or key in self._finished_tasks:
                         continue
@@ -421,6 +463,13 @@ class Engine:
             self._cond.notify_all()
         for task, e in to_commit:
             task.control_queue.put(ControlMessage(kind="commit", epoch=e))
+        # stamp every epoch this call made durable-and-committed, not just
+        # the carried one: a re-delivered dropped commit for epoch E must
+        # close E's commit span or the trace shows E wedged forever
+        for e in delivered:
+            if e != epoch:
+                self._span(e, "commit_delivered", worker=self.worker_index)
+        self._span(epoch, "commit_delivered", worker=self.worker_index)
 
     def heartbeat(self) -> float:
         """Liveness derived from actual engine progress: the stalest
@@ -452,6 +501,7 @@ class Engine:
         """Reference job_controller/mod.rs:325: checkpoint starts at sources.
         Triggers arriving before the engine is running are buffered and
         replayed by start() — never dropped."""
+        self._span(epoch, "trigger")
         with self._lock:
             if not self._running:
                 self._pending_triggers.append((epoch, then_stop))
@@ -482,7 +532,12 @@ class Engine:
                     acked = set(self._checkpoints.get(epoch, ()))
                     missing = tuple(sorted(
                         set(self.tasks) - acked - self._finished_tasks))
-                    return CheckpointWait("timeout", missing)
+                    expected = set(self.tasks) - self._finished_tasks
+                    report = timeline_report(
+                        self.job_id, epoch,
+                        trace_recorder.events(self.job_id, epoch),
+                        expected=expected)
+                    return CheckpointWait("timeout", missing, report)
                 self._cond.wait(timeout=min(remaining, 0.5))
         return CheckpointWait("completed")
 

@@ -19,7 +19,7 @@ import traceback
 from collections import deque
 from typing import Optional, Union
 
-from ..batch import Batch
+from ..batch import TIMESTAMP_FIELD, Batch
 from ..faults import fault_point
 from ..operators.base import Operator, OperatorContext, SourceOperator
 from ..operators.collector import Collector
@@ -125,6 +125,17 @@ class Task:
             self.metrics.queue_rem = self.metrics.queue_size
             inbox.metrics = self.metrics  # consumer-side transit histogram
         collector.metrics = self.metrics
+        # terminal operators (sinks) observe end-to-end event latency
+        self._terminal = not collector.out_edges
+
+    def _observe_sink_latency(self, batch: Batch) -> None:
+        """Sink-side end-to-end latency: wall clock at arrival minus the
+        batch's newest event timestamp (seconds)."""
+        if TIMESTAMP_FIELD not in batch:
+            return
+        ts_max = batch[TIMESTAMP_FIELD].max()
+        self.metrics.sink_event_latency.observe(
+            max(0.0, time.time() - float(ts_max) / 1e6))
 
     # ------------------------------------------------------------------ API
 
@@ -223,6 +234,10 @@ class Task:
             if merged is not None and merged != last_merged:
                 last_merged = merged
                 self.ctx.last_watermark = merged
+                if not merged.is_idle:
+                    # watermark-lag gauge: lag (processing time minus this
+                    # value) is derived at metrics-export time
+                    self.metrics.watermark_micros = merged.value
                 out = op.handle_watermark(merged, self.ctx, self.collector)
                 if out is not None:
                     self.collector.broadcast(Signal.watermark_of(out))
@@ -313,6 +328,8 @@ class Task:
                 self.metrics.add("arroyo_worker_messages_recv", item.num_rows)
                 self.metrics.add("arroyo_worker_bytes_recv", item.nbytes())
                 op.process_batch(item, self.ctx, self.collector, input_index=idx)
+                if self._terminal and item.num_rows:
+                    self._observe_sink_latency(item)
                 self.inbox.release(idx, item)
                 self.metrics.queue_rem = self.metrics.queue_size - self.inbox.used_rows()
                 continue

@@ -46,19 +46,39 @@ class Histogram:
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
-    def quantile(self, q: float) -> float:
-        """Bucket-upper-bound estimate of the q-quantile (coalesce
-        breakdown lines; not exported — prometheus consumers use _bucket)."""
+    def _quantile(self, q: float) -> tuple[float, bool]:
+        """(estimate, overflow): overflow=True means the quantile landed in
+        the +Inf bucket and the estimate is clamped to the largest finite
+        bound (a lower bound on the true value)."""
         if not self.count:
-            return 0.0
+            return 0.0, False
         rank = q * self.count
         seen = 0
         for i, c in enumerate(self.counts):
             seen += c
             if seen >= rank and c:
-                return float(self.buckets[i]) if i < len(self.buckets) \
-                    else float("inf")
-        return float("inf")
+                if i < len(self.buckets):
+                    return float(self.buckets[i]), False
+                break
+        return float(self.buckets[-1]), True
+
+    def quantile(self, q: float) -> float:
+        """Bucket-upper-bound estimate of the q-quantile (coalesce
+        breakdown lines; not exported — prometheus consumers use _bucket).
+        Overflow-bucket hits clamp to the largest finite bound instead of
+        returning inf, so downstream arithmetic (bench breakdown lines,
+        `top` columns) stays finite/parseable; use quantile_str to surface
+        the clamp."""
+        return self._quantile(q)[0]
+
+    def quantile_str(self, q: float, scale: float = 1.0,
+                     precision: int = 2) -> str:
+        """quantile(q) * scale formatted for breakdown lines; a clamped
+        overflow estimate is flagged with a leading '>' (it is only a
+        lower bound)."""
+        v, overflow = self._quantile(q)
+        s = f"{v * scale:.{precision}f}"
+        return f">{s}" if overflow else s
 
 
 # emitted batch sizes in rows (powers of two to the queue-budget scale)
@@ -66,15 +86,27 @@ EMIT_ROWS_BUCKETS = tuple(1 << i for i in range(17))  # 1 .. 65536
 # queue-transit wall latency in seconds (100us .. 2.5s)
 TRANSIT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+# sink-side end-to-end event latency (wall clock at the sink minus the
+# event's _timestamp): real deployments sit in the ms..minutes range;
+# synthetic generators with epoch-0 timestamps land in the overflow bucket,
+# which quantile() clamps (flagged '>' by quantile_str)
+SINK_LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                        1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0, 3600.0)
+# checkpoint phase durations (align/snapshot/ack/commit), seconds
+PHASE_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
 _HISTOGRAM_NAMES = ("arroyo_worker_emit_batch_rows",
-                    "arroyo_worker_queue_transit_seconds")
+                    "arroyo_worker_queue_transit_seconds",
+                    "arroyo_worker_sink_event_latency_seconds")
+CHECKPOINT_PHASES = ("align", "snapshot", "ack", "commit")
 
 
 class TaskMetrics:
     """Per-subtask counters (lock-free: single writer per task thread)."""
 
     __slots__ = ("job_id", "node_id", "subtask", "counters", "queue_size",
-                 "queue_rem", "emit_batch_rows", "queue_transit")
+                 "queue_rem", "emit_batch_rows", "queue_transit",
+                 "sink_event_latency", "watermark_micros")
 
     def __init__(self, job_id: str, node_id: str, subtask: int):
         self.job_id = job_id
@@ -88,6 +120,12 @@ class TaskMetrics:
         # measured, not asserted)
         self.emit_batch_rows = Histogram(EMIT_ROWS_BUCKETS)
         self.queue_transit = Histogram(TRANSIT_BUCKETS)
+        # event-time health (ISSUE 6): the task run loop stamps the current
+        # merged watermark here; lag (= processing time minus watermark,
+        # reference arroyo-metrics) is derived at export time. Sinks observe
+        # per-batch end-to-end event latency.
+        self.sink_event_latency = Histogram(SINK_LATENCY_BUCKETS)
+        self.watermark_micros: Optional[int] = None
 
     def histogram(self, name: str) -> Histogram:
         # explicit mapping: an unknown/typoed name must fail loudly at the
@@ -95,6 +133,7 @@ class TaskMetrics:
         return {
             "arroyo_worker_emit_batch_rows": self.emit_batch_rows,
             "arroyo_worker_queue_transit_seconds": self.queue_transit,
+            "arroyo_worker_sink_event_latency_seconds": self.sink_event_latency,
         }[name]
 
     def add(self, name: str, v: int = 1) -> None:
@@ -106,11 +145,23 @@ class TaskMetrics:
             return 0.0
         return max(0.0, 1.0 - self.queue_rem / self.queue_size)
 
+    def watermark_lag_seconds(self, now_us: Optional[float] = None) -> Optional[float]:
+        """Processing time minus current event-time watermark (seconds);
+        None until a watermark reached this subtask."""
+        if self.watermark_micros is None:
+            return None
+        now_us = time.time() * 1e6 if now_us is None else now_us
+        return max(0.0, (now_us - self.watermark_micros) / 1e6)
+
 
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._tasks: dict[tuple[str, str, int], TaskMetrics] = {}
+        # (job_id, phase) -> Histogram of per-epoch phase durations; fed by
+        # whoever declares an epoch durable (engine single-worker, the
+        # controller's coordinator otherwise) from the epoch trace
+        self._phases: dict[tuple[str, str], Histogram] = {}
 
     def task(self, job_id: str, node_id: str, subtask: int) -> TaskMetrics:
         key = (job_id, node_id, subtask)
@@ -121,6 +172,21 @@ class MetricsRegistry:
                 self._tasks[key] = tm
             return tm
 
+    def observe_epoch_phases(self, job_id: str, phases: dict) -> None:
+        """Record one completed epoch's phase durations (seconds)."""
+        with self._lock:
+            for phase, secs in phases.items():
+                if phase not in CHECKPOINT_PHASES:
+                    continue
+                h = self._phases.get((job_id, phase))
+                if h is None:
+                    h = self._phases[(job_id, phase)] = Histogram(PHASE_BUCKETS)
+                h.observe(float(secs))
+
+    def phase_histograms(self, job_id: str) -> dict[str, Histogram]:
+        with self._lock:
+            return {p: h for (j, p), h in self._phases.items() if j == job_id}
+
     def snapshot(self) -> list[TaskMetrics]:
         with self._lock:
             return list(self._tasks.values())
@@ -129,6 +195,9 @@ class MetricsRegistry:
         with self._lock:
             self._tasks = {
                 k: v for k, v in self._tasks.items() if k[0] != job_id
+            }
+            self._phases = {
+                k: v for k, v in self._phases.items() if k[0] != job_id
             }
 
     def prometheus_text(self) -> str:
@@ -149,6 +218,26 @@ class MetricsRegistry:
                      f'subtask="{t.subtask}"')
             lines.append(f"arroyo_worker_tx_queue_size{{{label}}} {t.queue_size}")
             lines.append(f"arroyo_worker_tx_queue_rem{{{label}}} {t.queue_rem}")
+        lines.append("# TYPE arroyo_worker_watermark_lag_seconds gauge")
+        now_us = time.time() * 1e6
+        for t in tasks:
+            lag = t.watermark_lag_seconds(now_us)
+            if lag is None:
+                continue
+            label = (f'job="{t.job_id}",operator="{t.node_id}",'
+                     f'subtask="{t.subtask}"')
+            lines.append(
+                f"arroyo_worker_watermark_lag_seconds{{{label}}} {lag:.6f}")
+
+        def emit_histogram(name: str, label: str, h: Histogram) -> None:
+            cum = 0
+            for le, c in zip(h.buckets, h.counts):
+                cum += c
+                lines.append(f'{name}_bucket{{{label},le="{le}"}} {cum}')
+            lines.append(f'{name}_bucket{{{label},le="+Inf"}} {h.count}')
+            lines.append(f"{name}_sum{{{label}}} {h.sum}")
+            lines.append(f"{name}_count{{{label}}} {h.count}")
+
         for name in _HISTOGRAM_NAMES:
             lines.append(f"# TYPE {name} histogram")
             for t in tasks:
@@ -157,36 +246,86 @@ class MetricsRegistry:
                     continue
                 label = (f'job="{t.job_id}",operator="{t.node_id}",'
                          f'subtask="{t.subtask}"')
-                cum = 0
-                for le, c in zip(h.buckets, h.counts):
-                    cum += c
-                    lines.append(f'{name}_bucket{{{label},le="{le}"}} {cum}')
-                lines.append(f'{name}_bucket{{{label},le="+Inf"}} {h.count}')
-                lines.append(f"{name}_sum{{{label}}} {h.sum}")
-                lines.append(f"{name}_count{{{label}}} {h.count}")
+                emit_histogram(name, label, h)
+        with self._lock:
+            phase_hists = sorted(self._phases.items())
+        if phase_hists:
+            lines.append("# TYPE arroyo_checkpoint_phase_seconds histogram")
+            for (job, phase), h in phase_hists:
+                emit_histogram("arroyo_checkpoint_phase_seconds",
+                               f'job="{job}",phase="{phase}"', h)
         return "\n".join(lines) + "\n"
 
     def job_metrics(self, job_id: str) -> dict:
         """Per-operator aggregates for the API
-        (reference /operator_metric_groups)."""
+        (reference /operator_metric_groups). Carries a ``per_subtask``
+        breakdown so the controller can merge snapshots from a multi-worker
+        set without double-counting (each worker reports its own subtasks;
+        union by subtask label is exact)."""
+        now_us = time.time() * 1e6
         out: dict[str, dict] = {}
         for t in self.snapshot():
             if t.job_id != job_id:
                 continue
-            op = out.setdefault(t.node_id, {
-                "subtasks": 0,
-                **dict.fromkeys(_COUNTER_NAMES, 0),
-                "backpressure": 0.0,
-                # rate is overwritten by the controller's windowed tracker
-                # while the job runs; a terminal snapshot reports 0 so the
-                # field contract holds for every consumer (UI charts)
-                "messages_per_sec": 0.0,
-            })
-            op["subtasks"] += 1
-            for name in _COUNTER_NAMES:
-                op[name] += t.counters[name]
-            op["backpressure"] = max(op["backpressure"], t.backpressure())
-        return out
+            op = out.setdefault(t.node_id, {"per_subtask": {}})
+            lag = t.watermark_lag_seconds(now_us)
+            transit_p99 = (round(t.queue_transit.quantile(0.99) * 1000, 3)
+                           if t.queue_transit.count else None)
+            sink_p99 = (round(t.sink_event_latency.quantile(0.99), 3)
+                        if t.sink_event_latency.count else None)
+            op["per_subtask"][str(t.subtask)] = {
+                **{name: t.counters[name] for name in _COUNTER_NAMES},
+                "backpressure": round(t.backpressure(), 4),
+                "watermark_lag_seconds": lag if lag is None else round(lag, 3),
+                "queue_transit_p99_ms": transit_p99,
+                "sink_event_latency_p99_s": sink_p99,
+            }
+        return {op: _op_aggregate(m["per_subtask"]) for op, m in out.items()}
+
+
+def _op_aggregate(per_subtask: dict[str, dict]) -> dict:
+    """Fold a per-subtask breakdown into one operator row (counters summed,
+    health gauges maxed — the worst subtask is the one an operator cares
+    about). Rate fields default to 0 and are overwritten by the
+    controller's windowed tracker while the job runs, so the field contract
+    holds for every consumer (UI charts, `top`)."""
+    def _max_opt(key):
+        vals = [s[key] for s in per_subtask.values() if s.get(key) is not None]
+        return max(vals) if vals else None
+
+    return {
+        "subtasks": len(per_subtask),
+        **{name: sum(int(s.get(name, 0)) for s in per_subtask.values())
+           for name in _COUNTER_NAMES},
+        "backpressure": max((float(s.get("backpressure", 0.0))
+                             for s in per_subtask.values()), default=0.0),
+        "messages_per_sec": 0.0,
+        "messages_recv_per_sec": 0.0,
+        "watermark_lag_seconds": _max_opt("watermark_lag_seconds"),
+        "queue_transit_p99_ms": _max_opt("queue_transit_p99_ms"),
+        "sink_event_latency_p99_s": _max_opt("sink_event_latency_p99_s"),
+        "per_subtask": per_subtask,
+    }
+
+
+def merge_job_metrics(snapshots) -> dict:
+    """Union per-operator snapshots shipped by the workers of one job into
+    a single controller-side view. Subtask labels are globally unique under
+    an assignment (each worker owns a disjoint slice), so union-by-label is
+    exact; embedded worker sets sharing one process registry report
+    identical full snapshots, which the union collapses instead of
+    double-counting."""
+    per_op: dict[str, dict[str, dict]] = {}
+    for snap in snapshots:
+        for op, m in (snap or {}).items():
+            if not isinstance(m, dict):
+                continue
+            per = m.get("per_subtask")
+            if not per:
+                # legacy flat snapshot (no breakdown): synthesize one entry
+                per = {"*": {name: m.get(name, 0) for name in _COUNTER_NAMES}}
+            per_op.setdefault(op, {}).update(per)
+    return {op: _op_aggregate(per) for op, per in per_op.items()}
 
 
 registry = MetricsRegistry()
@@ -206,6 +345,11 @@ class RateTracker:
         cutoff = now - self.window_s
         while len(pts) > 2 and pts[0][0] < cutoff:
             pts.pop(0)
+
+    def reset(self) -> None:
+        """Drop all points — counters are about to restart from zero (e.g.
+        a replacement worker set), so old points would yield negative rates."""
+        self._points.clear()
 
     def rate(self, key: str) -> float:
         pts = self._points.get(key)

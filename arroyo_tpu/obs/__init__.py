@@ -1,0 +1,19 @@
+"""Observability plane: epoch-lifecycle tracing + the live job view.
+
+``obs.trace`` records every checkpoint epoch's span tree (trigger ->
+per-subtask alignment -> snapshot -> ack -> metadata durable -> commit
+fan-out) into a bounded in-memory ring and exports it as Chrome trace-event
+JSON; ``obs.topview`` renders the controller-DB-backed per-operator table
+behind ``python -m arroyo_tpu top``. The watermark-lag gauge, sink
+end-to-end latency, and checkpoint phase histograms live in
+``arroyo_tpu.metrics`` next to the existing task counters.
+"""
+
+from .trace import (  # noqa: F401 - public API
+    EpochTraceRecorder,
+    chrome_trace,
+    dominant_phase,
+    phase_durations,
+    recorder,
+    timeline_report,
+)

@@ -1,0 +1,104 @@
+"""Controller-side live job view: the rendering behind `arroyo_tpu top`.
+
+Pure formatting over data the controller already persists to the shared DB
+(job row, per-operator metrics snapshot, checkpoint history with phase
+durations) so the CLI, tests, and any future UI panel share one view model:
+per-operator rows/s in/out, backpressure, queue-transit p99, watermark lag,
+and the last epoch's duration with its dominant phase — a hot subtask or a
+stalled watermark is visible at a glance.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from . import trace
+
+
+def _fmt_rate(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    if v >= 1e6:
+        return f"{v / 1e6:.2f}M"
+    if v >= 1e3:
+        return f"{v / 1e3:.1f}k"
+    return f"{v:.1f}"
+
+
+def _fmt_secs(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    if v >= 3600:
+        return f"{v / 3600:.1f}h"
+    if v >= 60:
+        return f"{v / 60:.1f}m"
+    if v >= 1:
+        return f"{v:.2f}s"
+    return f"{v * 1e3:.1f}ms"
+
+
+def last_epoch_line(checkpoints: list[dict]) -> Optional[str]:
+    """"last epoch 7: 1.23s (snapshot 0.91s <- dominant, align 0.21s, ...)"
+    from the newest checkpoint row carrying phase durations."""
+    for row in sorted(checkpoints, key=lambda r: -int(r["epoch"])):
+        if row.get("state") not in ("complete", "compacted"):
+            continue
+        phases = row.get("phases")
+        if isinstance(phases, str):
+            try:
+                phases = json.loads(phases)
+            except json.JSONDecodeError:
+                phases = None
+        if not phases:
+            continue
+        total = sum(phases.values())
+        dom = trace.dominant_phase(phases)
+        parts = ", ".join(
+            f"{k} {_fmt_secs(v)}" + (" <- dominant" if k == dom else "")
+            for k, v in sorted(phases.items(), key=lambda kv: -kv[1])
+        )
+        return f"last epoch {row['epoch']}: {_fmt_secs(total)} ({parts})"
+    return None
+
+
+_COLUMNS = ("operator", "sub", "in/s", "out/s", "backpr",
+            "transit p99", "wm lag", "sink p99")
+
+
+def render(job: dict, metrics: Optional[dict],
+           checkpoints: Optional[list[dict]] = None) -> str:
+    """One refresh frame of the live job view (plain text, one table)."""
+    head = (f"job {job['id']}  state={job['state']}  "
+            f"workers={job.get('n_workers', 1)}  "
+            f"restarts={job.get('restarts', 0)}  "
+            f"epoch={job.get('checkpoint_epoch', 0)}")
+    if not metrics:
+        return head + "\n  (no metrics snapshot yet)"
+    rows: list[tuple[str, ...]] = []
+    for op in sorted(metrics):
+        m = metrics[op]
+        if not isinstance(m, dict):
+            continue
+        p99 = m.get("queue_transit_p99_ms")
+        rows.append((
+            op,
+            str(m.get("subtasks", len(m.get("per_subtask", {})) or 1)),
+            _fmt_rate(m.get("messages_recv_per_sec")),
+            _fmt_rate(m.get("messages_per_sec")),
+            f"{float(m.get('backpressure', 0.0)):.2f}",
+            "-" if p99 is None else f"{float(p99):.1f}ms",
+            _fmt_secs(m.get("watermark_lag_seconds")),
+            _fmt_secs(m.get("sink_event_latency_p99_s")),
+        ))
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(_COLUMNS)]
+    lines = [head, "  ".join(c.ljust(w) for c, w in zip(_COLUMNS, widths))]
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    epoch_line = last_epoch_line(checkpoints or [])
+    if epoch_line:
+        lines.append(epoch_line)
+    return "\n".join(lines)

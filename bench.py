@@ -318,20 +318,35 @@ def run_config(name, build, backend, event_count, batch_size, queue_mult=2):
 
 
 def coalesce_breakdown(job_id):
-    """Aggregate the coalescing histograms (emit-batch rows, queue-transit
-    seconds) across every task of one job (last rep: run_config clears)."""
-    from arroyo_tpu.metrics import (EMIT_ROWS_BUCKETS, TRANSIT_BUCKETS,
-                                    Histogram, registry)
+    """Aggregate the instrumentation histograms (emit-batch rows,
+    queue-transit seconds, sink end-to-end latency) across every task of
+    one job (last rep: run_config clears)."""
+    from arroyo_tpu.metrics import (EMIT_ROWS_BUCKETS, SINK_LATENCY_BUCKETS,
+                                    TRANSIT_BUCKETS, Histogram, registry)
 
-    em, qt = Histogram(EMIT_ROWS_BUCKETS), Histogram(TRANSIT_BUCKETS)
+    em, qt, sk = (Histogram(EMIT_ROWS_BUCKETS), Histogram(TRANSIT_BUCKETS),
+                  Histogram(SINK_LATENCY_BUCKETS))
     for t in registry.snapshot():
         if t.job_id != job_id:
             continue
-        for agg, h in ((em, t.emit_batch_rows), (qt, t.queue_transit)):
+        for agg, h in ((em, t.emit_batch_rows), (qt, t.queue_transit),
+                       (sk, t.sink_event_latency)):
             agg.counts = [a + b for a, b in zip(agg.counts, h.counts)]
             agg.count += h.count
             agg.sum += h.sum
-    return em, qt
+    return em, qt, sk
+
+
+def histogram_summary(h, scale=1.0):
+    """Compact JSON-able distribution summary; overflow-bucket quantiles
+    are clamped lower bounds flagged with '>' (Histogram.quantile_str)."""
+    return {
+        "count": h.count,
+        "mean": round(h.mean() * scale, 3),
+        "p50": h.quantile_str(0.5, scale=scale),
+        "p90": h.quantile_str(0.9, scale=scale),
+        "p99": h.quantile_str(0.99, scale=scale),
+    }
 
 
 def latency_percentiles(rows, latency_log, arrival_walls, window_end_of):
@@ -542,11 +557,11 @@ def main() -> None:
                 best_eps, best_lat = eps, (p50, p99)
             if p99 is not None and (worst_p99 is None or p99 > worst_p99):
                 worst_p99 = p99
-        em, qt = coalesce_breakdown(f"bench-{name}-jax")
+        em, qt, sk = coalesce_breakdown(f"bench-{name}-jax")
         print(f"# {name} coalesce: {em.count} emitted batches, "
               f"mean {em.mean():,.0f} rows/batch; queue transit "
-              f"p50 {qt.quantile(0.5) * 1000:.2f}ms "
-              f"p99 {qt.quantile(0.99) * 1000:.2f}ms ({qt.count} transits)",
+              f"p50 {qt.quantile_str(0.5, scale=1000)}ms "
+              f"p99 {qt.quantile_str(0.99, scale=1000)}ms ({qt.count} transits)",
               file=sys.stderr)
         extra[name] = {
             "events_per_sec": round(best_eps, 1),
@@ -556,6 +571,13 @@ def main() -> None:
                 "emitted_batches": em.count,
                 "mean_emit_rows": round(em.mean(), 1),
                 "queue_transit_p99_ms": round(qt.quantile(0.99) * 1000, 3),
+            },
+            # full distribution summaries so the perf trajectory captures
+            # latency shapes, not just ev/s (BENCH_*.json archives these)
+            "metrics": {
+                "emit_batch_rows": histogram_summary(em),
+                "queue_transit_ms": histogram_summary(qt, scale=1000),
+                "sink_event_latency_s": histogram_summary(sk),
             },
         }
         budget = P99_BUDGET_MS.get(name)

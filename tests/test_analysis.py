@@ -431,6 +431,31 @@ def test_lr107_emit_in_loop():
     assert "LR107" not in ids_of(lint_source(waived, "arroyo_tpu/operators/x.py"))
 
 
+def test_lr108_bare_print():
+    bad = (
+        "def poll(self):\n"
+        "    print('got batch', 42)\n"
+    )
+    # library code: worker stdout is the JSON-lines control protocol
+    assert "LR108" in ids_of(lint_source(bad, "arroyo_tpu/engine/x.py"))
+    assert "LR108" in ids_of(lint_source(bad, "arroyo_tpu/connectors/x.py"))
+    # CLI entry points own their stdout; bench/tools live outside the package
+    assert "LR108" not in ids_of(lint_source(bad, "arroyo_tpu/cli.py"))
+    assert "LR108" not in ids_of(lint_source(bad, "arroyo_tpu/__main__.py"))
+    assert "LR108" not in ids_of(lint_source(bad, "tools/profile.py"))
+    assert "LR108" not in ids_of(lint_source(bad, "bench.py"))
+    logged = (
+        "import logging\n"
+        "def poll(self):\n"
+        "    logging.getLogger('arroyo_tpu.engine').info('got batch %d', 42)\n"
+    )
+    assert "LR108" not in ids_of(lint_source(logged, "arroyo_tpu/engine/x.py"))
+    waived = bad.replace(
+        "print('got batch', 42)",
+        "print('got batch', 42)  # lint: waive LR108 — CLI-owned output")
+    assert "LR108" not in ids_of(lint_source(waived, "arroyo_tpu/engine/x.py"))
+
+
 def test_waivers():
     bad = (
         "def f():\n"

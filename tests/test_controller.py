@@ -269,6 +269,18 @@ def test_stuck_checkpoint_watchdog_subsume_retry_recover(tmp_path, _storage):
         assert jc.watchdog_failed_epochs >= 2
         assert jc.watchdog_escalations >= 1, (
             "K consecutive wedges never escalated to a whole-set restore")
+        # trace-backed wedge diagnostic: the escalation report attaches the
+        # epoch timeline and names the exact stuck subtask ("node/sub:
+        # snapshot started, never acked" / "barrier never arrived"); the
+        # tail survives the failure-message truncation by construction
+        import re as _re
+
+        msg = job["failure_message"] or ""
+        assert _re.search(
+            r"\S+/\d+: (snapshot started, never acked|"
+            r"barrier never arrived|aligning)", msg), msg
+        # epoch timelines are queryable postmortem from the controller DB
+        assert db.list_traces(jid)
         _assert_golden(out)
     finally:
         faults.clear()
@@ -443,6 +455,22 @@ def test_process_scheduler_two_worker_set(tmp_path, _storage):
         # the coordinator (not any worker) recorded globally durable epochs
         assert any(c["state"] == "complete" for c in db.list_checkpoints(jid))
         assert jc.checkpoint_event_log, "no coordinated checkpoints happened"
+        # multi-worker metrics aggregation: the controller snapshot merges
+        # BOTH subprocesses' registries (union by subtask label) instead of
+        # one worker's report overwriting the other's operators
+        snap = db.get_metrics(jid) or {}
+        labels = {(op, sub) for op, m in snap.items() if isinstance(m, dict)
+                  for sub in m.get("per_subtask", {})}
+        assert any(
+            {(op, "0"), (op, "1")} <= labels for op, _ in labels
+        ), f"no operator carries both workers' subtask labels: {sorted(labels)}"
+        # the workers relayed their epoch span events; the controller
+        # persisted whole-job trace timelines with both workers' acks
+        traces = db.list_traces(jid)
+        assert traces, "no epoch traces persisted to the controller DB"
+        ack_subs = {(e["node"], e["subtask"]) for t in traces
+                    for e in t["events"] if e["event"] == "ack"}
+        assert len(ack_subs) >= 2, ack_subs
         _assert_golden(out)
     finally:
         os.environ.pop("ARROYO_TPU__TESTING__SOURCE_READ_DELAY_MICROS", None)

@@ -6,6 +6,10 @@
 #   tools/lint.sh --check      additionally `check` every smoke query and
 #                              assert every queries_bad catalog entry still
 #                              produces its annotated diagnostic
+#   tools/lint.sh --metrics-catalog
+#                              assert every metric name emitted in code
+#                              appears in the README "Observability"
+#                              catalog (grep-based; keeps docs honest)
 #
 # Exit non-zero on any unwaived lint finding or unexpected check result.
 set -euo pipefail
@@ -14,6 +18,30 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 python -m arroyo_tpu lint arroyo_tpu
+
+if [[ "${1:-}" == "--metrics-catalog" ]]; then
+    python - <<'EOF'
+import glob, re, sys
+
+# every prometheus series family this codebase can emit (string literals
+# in the package; _bucket/_sum/_count suffixes are format-time derived)
+NAME_RE = re.compile(r"arroyo_(?:worker|checkpoint)_[a-z0-9_]+")
+code_names: set[str] = set()
+for p in glob.glob("arroyo_tpu/**/*.py", recursive=True):
+    with open(p) as f:
+        code_names |= set(NAME_RE.findall(f.read()))
+with open("README.md") as f:
+    doc_names = set(NAME_RE.findall(f.read()))
+missing = sorted(code_names - doc_names)
+if missing:
+    print("metrics-catalog: emitted in code but missing from the README "
+          "'Observability' catalog:")
+    for m in missing:
+        print(f"  {m}")
+    sys.exit(1)
+print(f"metrics-catalog: ok ({len(code_names)} metric names documented)")
+EOF
+fi
 
 if [[ "${1:-}" == "--check" ]]; then
     python - <<'EOF'
