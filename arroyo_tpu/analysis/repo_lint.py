@@ -40,6 +40,16 @@ Rule catalog:
                                parsing — and it bypasses the configured
                                logging format/level; route through
                                ``logging.getLogger(...)``
+    LR109 ad-hoc-self-timing   ``time.time()``/``time.monotonic()``/
+                               ``time.perf_counter()``/``time.thread_time()``
+                               in operator/window/state code: self-
+                               measurement belongs in the profiler hooks
+                               (obs/profile.py TaskProfiler wraps every
+                               operator hook), or cost attribution
+                               fragments into untrackable side channels.
+                               Legitimate wall-clock uses (cache TTLs,
+                               event-time idle detection, coalescing
+                               deadlines) carry waivers naming the reason
 
 Waivers: append ``# lint: waive LR1xx — justification`` on the flagged
 line (or the line above). A waiver with no justification text does not
@@ -429,6 +439,35 @@ def rule_lr108(mod: ModuleInfo) -> Iterable[Finding]:
                    "waive with justification for genuinely CLI-owned output")
 
 
+_LR109_TIME_FNS = {"time", "monotonic", "perf_counter", "thread_time",
+                   "process_time", "monotonic_ns", "perf_counter_ns",
+                   "thread_time_ns", "process_time_ns"}
+
+
+def rule_lr109(mod: ModuleInfo) -> Iterable[Finding]:
+    """Clock reads in operator/window/state code. Self-time measurement is
+    the profiler's job (obs/profile.py wraps every operator hook with
+    wall + thread-CPU accounting) — a stray stopwatch in an operator both
+    duplicates that attribution and, worse, escapes it. Non-measurement
+    clock uses (cache TTLs, idle detection, flush deadlines) are real and
+    carry waivers so each documents why it is not self-measurement."""
+    if not mod.in_dirs("operators", "windows", "state", "ops"):
+        return
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Call) \
+                and _receiver_name(n) in ("time", "_time") \
+                and _call_name(n) in _LR109_TIME_FNS:
+            yield (n.lineno,
+                   f"{_receiver_name(n)}.{_call_name(n)}() in operator/"
+                   "window/state code: self-measurement belongs in the "
+                   "profiler hooks (obs/profile.py), where it lands in "
+                   "arroyo_worker_self_time_seconds instead of a side "
+                   "channel",
+                   "let the task run loop attribute the cost; for a "
+                   "genuine wall-clock need (TTL, idle detection, flush "
+                   "deadline), waive with the reason")
+
+
 RULES: tuple[tuple[str, Severity, object], ...] = (
     ("LR101", Severity.ERROR, rule_lr101),
     ("LR102", Severity.ERROR, rule_lr102),
@@ -438,6 +477,7 @@ RULES: tuple[tuple[str, Severity, object], ...] = (
     ("LR106", Severity.ERROR, rule_lr106),
     ("LR107", Severity.ERROR, rule_lr107),
     ("LR108", Severity.ERROR, rule_lr108),
+    ("LR109", Severity.ERROR, rule_lr109),
 )
 
 # fault sites every full-package lint must find wired (mirrors faults.SITES;

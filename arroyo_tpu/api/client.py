@@ -106,6 +106,11 @@ class ArroyoClient:
     def job_metrics(self, job_id: str) -> dict:
         return self._req("GET", f"/api/v1/jobs/{job_id}/metrics")
 
+    def job_profile(self, job_id: str) -> dict:
+        """Runtime cost profile: per-operator busy%, self-time, state
+        rows/bytes, hot keys (what `arroyo_tpu explain` renders)."""
+        return self._req("GET", f"/api/v1/jobs/{job_id}/profile")
+
     def job_traces(self, job_id: str, epoch: "Optional[int]" = None,
                    raw_events: bool = False) -> dict:
         """Checkpoint epoch traces: Chrome trace-event JSON by default,

@@ -153,6 +153,10 @@ def spec() -> dict:
                 "get": _op("job_output", "preview sink rows", ["job_id"])},
             "/api/v1/jobs/{job_id}/metrics": {
                 "get": _op("job_metrics", "operator metric groups", ["job_id"])},
+            "/api/v1/jobs/{job_id}/profile": {
+                "get": _op("job_profile", "runtime cost profile (per-operator "
+                           "busy%, self-time, state sizes, hot keys)",
+                           ["job_id"])},
             "/api/v1/jobs/{job_id}/traces": {
                 "get": _op("job_traces", "checkpoint epoch traces "
                            "(Chrome trace-event JSON; ?format=events for "

@@ -105,6 +105,7 @@ class ApiServer:
         ("GET", r"^/api/v1/jobs/([^/]+)/checkpoints$", "_job_checkpoints"),
         ("GET", r"^/api/v1/jobs/([^/]+)/output$", "_job_output"),
         ("GET", r"^/api/v1/jobs/([^/]+)/metrics$", "_job_metrics"),
+        ("GET", r"^/api/v1/jobs/([^/]+)/profile$", "_job_profile"),
         ("GET", r"^/api/v1/jobs/([^/]+)/traces$", "_job_traces"),
         ("GET", r"^/api/v1/connectors$", "_connectors"),
         ("POST", r"^/api/v1/connection_profiles$", "_create_profile"),
@@ -317,8 +318,8 @@ class ApiServer:
     def _pipeline_graph(self, h, pid):
         """Planned dataflow DAG for the UI's graph view (reference
         PipelineGraph.tsx consumes the pipeline's edges/nodes)."""
-        from ..sql import plan_query
         from ..sql.lexer import SqlError
+        from ..sql.planner import executed_graph_view
 
         p = self.db.get_pipeline(pid)
         if not p:
@@ -326,28 +327,14 @@ class ApiServer:
             return
         try:
             self._activate_udfs()
-            pp = plan_query(p["query"],
-                            connection_tables=self.db.list_connection_tables())
-            par = int(p.get("parallelism") or 1)
-            if par > 1:
-                # show the DAG as it executes, not the p=1 plan
-                from ..sql.planner import set_parallelism
-
-                set_parallelism(pp.graph, par)
+            # the DAG as it EXECUTES (parallelism + chaining), so node ids
+            # line up with runtime metric/profile keys — see the helper
+            nodes, edges = executed_graph_view(
+                p["query"], int(p.get("parallelism") or 1),
+                connection_tables=self.db.list_connection_tables())
         except SqlError as e:
             h._json(400, {"error": str(e)})
             return
-        g = pp.graph
-        nodes = [
-            {"id": n.node_id, "op": n.op.value,
-             "description": n.description or n.op.value,
-             "parallelism": n.parallelism}
-            for n in g.nodes.values()
-        ]
-        edges = [
-            {"src": e.src, "dst": e.dst, "type": e.edge_type.value}
-            for e in g.edges
-        ]
         h._json(200, {"nodes": nodes, "edges": edges})
 
     def _list_jobs(self, h):
@@ -442,6 +429,19 @@ class ApiServer:
             from ..metrics import registry as metrics_registry
 
             data = metrics_registry.job_metrics(jid)
+        h._json(200, {"data": data})
+
+    def _job_profile(self, h, jid):
+        """Runtime cost profile (obs.profile): per-operator busy%, self-time
+        by category, state rows/bytes per table, merged top-k hot keys, and
+        late-row drops — the controller-persisted snapshot, falling back to
+        a live derivation from the local registry for embedded jobs."""
+        data = self.db.get_profile(jid)
+        if data is None:
+            from ..metrics import registry as metrics_registry
+            from ..obs.profile import job_profile
+
+            data = job_profile(metrics_registry.job_metrics(jid))
         h._json(200, {"data": data})
 
     def _connectors(self, h):

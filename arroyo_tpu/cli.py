@@ -374,6 +374,71 @@ def _cmd_trace(args) -> int:
     return 0
 
 
+def _cmd_explain(args) -> int:
+    """EXPLAIN ANALYZE for a job: render the logical plan sink-first,
+    annotated with the live runtime cost profile (per-operator busy%,
+    rows/s, self-time by category, state rows/bytes, top-k hot keys,
+    late-row drops) merged across every worker of the set. Reads the
+    controller DB directly (--db) or the cluster API (--api)."""
+    import urllib.error
+    import urllib.request
+
+    from arroyo_tpu.obs.profile import job_profile, render_explain
+
+    def plan_nodes_edges(sql, parallelism):
+        """Plan the pipeline the way the engine runs it (the shared
+        executed_graph_view: parallelism + chaining applied) so plan node
+        ids line up with runtime metrics; a plan failure (e.g.
+        unregistered UDFs) degrades to a plain per-operator profile
+        listing instead of erroring out."""
+        try:
+            import arroyo_tpu
+            from arroyo_tpu.sql.planner import executed_graph_view
+
+            arroyo_tpu._load_operators()
+            return executed_graph_view(sql, parallelism)
+        except Exception:  # noqa: BLE001 - plan is decoration, profile is data
+            return [], []
+
+    if args.db:
+        from arroyo_tpu.controller import Database
+
+        db = Database(args.db)
+        job = db.get_job(args.job_id)
+        if job is None:
+            print(f"job {args.job_id} not found", file=sys.stderr)
+            return 1
+        profile = (db.get_profile(args.job_id)
+                   or job_profile(db.get_metrics(args.job_id)))
+        pipeline = db.get_pipeline(job["pipeline_id"]) or {}
+        nodes, edges = plan_nodes_edges(
+            pipeline.get("query", ""), int(pipeline.get("parallelism") or 1))
+    else:
+        base = args.api.rstrip("/")
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return json.load(r)
+
+        try:
+            job = get(f"/api/v1/jobs/{args.job_id}")
+        except urllib.error.HTTPError:
+            print(f"job {args.job_id} not found", file=sys.stderr)
+            return 1
+        profile = get(f"/api/v1/jobs/{args.job_id}/profile").get("data") or {}
+        nodes, edges = [], []
+        try:
+            g = get(f"/api/v1/pipelines/{job['pipeline_id']}/graph")
+            nodes, edges = g.get("nodes", []), g.get("edges", [])
+        except (urllib.error.HTTPError, urllib.error.URLError, KeyError):
+            pass
+    if not profile:
+        print(f"no profile snapshot recorded yet for {args.job_id} "
+              "(workers report ~1/s once running)", file=sys.stderr)
+    print(render_explain(nodes, edges, profile or {}, job))
+    return 0
+
+
 def _cmd_top(args) -> int:
     """Live per-operator job view from the controller DB: rows/s in/out,
     backpressure, queue-transit p99, watermark lag, and the last epoch's
@@ -562,6 +627,17 @@ def main(argv: Optional[list[str]] = None) -> int:
     op.add_argument("--once", action="store_true",
                     help="print one frame and exit (no screen clearing)")
     op.set_defaults(fn=_cmd_top)
+
+    ep = sub.add_parser("explain", help="EXPLAIN ANALYZE: the logical plan "
+                                        "annotated with live per-operator "
+                                        "busy%, rows/s, state sizes, and "
+                                        "hot keys")
+    ep.add_argument("job_id")
+    ep.add_argument("--api", default="http://127.0.0.1:5115",
+                    help="cluster API base url")
+    ep.add_argument("--db", default=None,
+                    help="read the controller DB file directly instead")
+    ep.set_defaults(fn=_cmd_explain)
 
     kp = sub.add_parser("check", help="static analysis of a SQL pipeline "
                                       "(plan + dataflow validation, no run)")

@@ -94,6 +94,21 @@ _DEFAULTS: dict[str, Any] = {
         # controller-owned cross-worker checkpoint coordination
         "workers-per-job": 1,
     },
+    "profile": {
+        # runtime cost attribution (obs/profile.py): per-operator self-time
+        # accounting in the task run loop, state-size gauges, and key-skew
+        # sketches; cheap enough to stay on in production (the overhead
+        # guard test holds the run-loop wrapping under 5% wall)
+        "enabled": True,
+        "sketch": {
+            "capacity": 64,      # space-saving summary entries per subtask
+            # count 1/N batches; 1 (default) is row-deterministic under
+            # replay regardless of coalescing batch boundaries — sampling
+            # >1 is cheaper but boundary-sensitive (see obs/sketch.py)
+            "sample-every": 1,
+            "topk": 5,           # hot keys exported per operator
+        },
+    },
     "api": {"http-port": 5115},
     "admin": {"http-port": 5114},
 }

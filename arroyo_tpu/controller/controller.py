@@ -369,6 +369,11 @@ class JobController:
             m["messages_recv_per_sec"] = round(self.rates.rate(f"{op}.recv"), 2)
         if merged:
             self.db.record_metrics(self.job_id, merged)
+            # compact per-job cost profile (obs.profile): the queryable
+            # snapshot behind /profile and `arroyo_tpu explain`
+            from ..obs.profile import job_profile
+
+            self.db.record_profile(self.job_id, job_profile(merged))
 
     def _on_worker_finished(self, widx: int, h: WorkerHandle, job: dict) -> bool:
         """One worker of the set drained. Returns True when the whole set
@@ -662,6 +667,9 @@ class ControllerServer:
                 final = metrics_registry.job_metrics(jid)
                 if final:
                     self.db.record_metrics(jid, final)
+                    from ..obs.profile import job_profile
+
+                    self.db.record_profile(jid, job_profile(final))
                 metrics_registry.clear_job(jid)
                 # flush every buffered epoch trace to the DB (postmortems
                 # via the API/`trace` CLI survive the recorder eviction)

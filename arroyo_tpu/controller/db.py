@@ -96,6 +96,11 @@ CREATE TABLE IF NOT EXISTS job_metrics (
     data TEXT NOT NULL,
     updated_at REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS job_profiles (
+    job_id TEXT PRIMARY KEY,
+    data TEXT NOT NULL,           -- JSON compact per-operator cost profile
+    updated_at REAL NOT NULL
+);
 """
 
 _OUTPUT_CAP = 10_000  # preview rows retained per job
@@ -452,6 +457,26 @@ class Database:
         with self._lock:
             row = self._conn.execute(
                 "SELECT data FROM job_metrics WHERE job_id=?", (job_id,)
+            ).fetchone()
+        return json.loads(row["data"]) if row else None
+
+    def record_profile(self, job_id: str, data: dict) -> None:
+        """Latest compact per-operator cost profile (obs.profile.job_profile
+        over the merged worker snapshots): busy%, self-time, state sizes,
+        hot keys — what `explain`/`/profile` serve."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO job_profiles (job_id, data, updated_at) VALUES (?,?,?) "
+                "ON CONFLICT(job_id) DO UPDATE SET data=excluded.data, "
+                "updated_at=excluded.updated_at",
+                (job_id, json.dumps(data), time.time()),
+            )
+            self._conn.commit()
+
+    def get_profile(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT data FROM job_profiles WHERE job_id=?", (job_id,)
             ).fetchone()
         return json.loads(row["data"]) if row else None
 

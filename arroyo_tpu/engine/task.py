@@ -19,7 +19,7 @@ import traceback
 from collections import deque
 from typing import Optional, Union
 
-from ..batch import TIMESTAMP_FIELD, Batch
+from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch
 from ..faults import fault_point
 from ..operators.base import Operator, OperatorContext, SourceOperator
 from ..operators.collector import Collector
@@ -77,6 +77,11 @@ class SourceContext:
         # coalescing flush point for source emissions
         self._task.last_progress = time.monotonic()
         self._task.collector.flush_expired(self._task.last_progress)
+        if self._task.profiler is not None:
+            # incremental self-time: live snapshots must show a streaming
+            # source's busy%, not wait for run() to return
+            self._task.profiler.source_tick()
+            self._task.profiler.refresh()
         try:
             return self._task.control_queue.get_nowait()
         except _queue.Empty:
@@ -119,6 +124,27 @@ class Task:
         self.metrics = _metrics_registry.task(
             task_info.job_id, task_info.node_id, task_info.subtask_index
         )
+        # cost attribution (obs/profile.py): self-time wrapping for every
+        # operator hook, state-size gauges, and the key-skew sketch. None
+        # when profile.enabled is off — the run loop then does zero extra
+        # work. Built AFTER the table-manager restore (Engine.build runs
+        # restore before constructing the Task) so the sketch resumes the
+        # exact summary the checkpoint persisted.
+        from ..obs.profile import make_profiler
+
+        self.profiler = make_profiler(self.metrics, task_info,
+                                      ctx.table_manager, operator)
+        # one key space per sketch: an operator that keyed-shuffles its
+        # OUTPUT is observed at the collector's shuffle boundary (the new
+        # routing keys — what a re-keying operator is about to melt a
+        # downstream subtask with); only operators that do NOT shuffle
+        # observe their keyed INPUT (window/join insert paths). Feeding
+        # both would mix two hash spaces and double-count pass-throughs.
+        from ..graph import EdgeType as _EdgeType
+
+        self.observe_input_keys = not any(
+            len(e.dests) > 1 and e.edge_type != _EdgeType.FORWARD
+            for e in collector.out_edges)
         if inbox is not None:
             self.metrics.queue_size = inbox.row_budget * inbox.n_inputs
             # an idle queue is an EMPTY queue, not a full one
@@ -175,14 +201,30 @@ class Task:
 
     def _run_source(self) -> None:
         op: SourceOperator = self.operator  # type: ignore[assignment]
+        prof = self.profiler
         op.on_start(self.ctx)
         sctx = SourceContext(self)
-        finish = op.run(sctx, self.collector)
-        op.on_close(self.ctx, self.collector)
+        if prof is None:
+            finish = op.run(sctx, self.collector)
+            op.on_close(self.ctx, self.collector)
+        else:
+            # thread-CPU accumulates incrementally via source_tick (the
+            # connector poll path) so LIVE snapshots carry the source's
+            # busy%; this first tick just stamps the mark, the final one
+            # catches the tail after run() returns
+            prof.source_tick()
+            finish = op.run(sctx, self.collector)
+            prof.source_tick()
+            t0 = prof.begin()
+            op.on_close(self.ctx, self.collector)
+            prof.end("close", t0)
+            prof.refresh(force=True)
         if finish == SourceFinishType.GRACEFUL:
             # persist the drained offset so a restore from ANY later epoch
             # does not replay this source (state is constant after EOF and
             # all emitted data precedes downstream epoch barriers)
+            if prof is not None:
+                prof.checkpoint_sketch()
             self.ctx.table_manager.checkpoint("final", self.ctx.watermark())
             self.collector.broadcast(Signal.end_of_data())
         elif finish == SourceFinishType.IMMEDIATE:
@@ -200,7 +242,17 @@ class Task:
         self._resp("checkpoint_event", checkpoint_event=CheckpointEvent(
             barrier.epoch, self.task_info.node_id, self.task_info.subtask_index,
             int(time.time() * 1e6), "started_checkpointing"))
+        prof = self.profiler
+        t0 = prof.begin() if prof is not None else None
+        if prof is not None:
+            prof.checkpoint_sketch()
         meta = self.ctx.table_manager.checkpoint(barrier.epoch, self.ctx.watermark())
+        if prof is not None:
+            prof.end("checkpoint", t0)
+            # the snapshot CPU is attributed above; the source's rolling
+            # process clock must not count it again
+            prof.source_reset()
+            prof.refresh(force=True)
         # chaos hook: a crash HERE is the worst case — state files for this
         # epoch are on disk but the epoch never completes (no job metadata),
         # so recovery must ignore them and restore the previous epoch
@@ -212,6 +264,7 @@ class Task:
 
     def _run_operator(self) -> None:
         op: Operator = self.operator  # type: ignore[assignment]
+        prof = self.profiler
         op.on_start(self.ctx)
         holder = WatermarkHolder(self.n_inputs)
         finished: set[int] = set()
@@ -238,7 +291,12 @@ class Task:
                     # watermark-lag gauge: lag (processing time minus this
                     # value) is derived at metrics-export time
                     self.metrics.watermark_micros = merged.value
+                # watermark handling (window closes) is data-path work
+                # driven by the stream: it attributes to "process"
+                t0 = prof.begin() if prof is not None else None
                 out = op.handle_watermark(merged, self.ctx, self.collector)
+                if prof is not None:
+                    prof.end("process", t0)
                 if out is not None:
                     self.collector.broadcast(Signal.watermark_of(out))
 
@@ -246,8 +304,16 @@ class Task:
             self._resp("checkpoint_event", checkpoint_event=CheckpointEvent(
                 b.epoch, self.task_info.node_id, self.task_info.subtask_index,
                 int(time.time() * 1e6), "started_checkpointing"))
+            t0 = prof.begin() if prof is not None else None
             op.handle_checkpoint(b, self.ctx, self.collector)
+            if prof is not None:
+                prof.checkpoint_sketch()
             meta = self.ctx.table_manager.checkpoint(b.epoch, self.ctx.watermark())
+            if prof is not None:
+                prof.end("checkpoint", t0)
+                # barrier time is when host tables mirror device state:
+                # the freshest moment for the state-size gauges
+                prof.refresh(force=True)
             # chaos hook: mirror of run_source_checkpoint — crash with this
             # subtask's epoch state written but the epoch incomplete
             fault_point("worker", barrier=b.epoch,
@@ -313,8 +379,14 @@ class Task:
                         self.finished_clean = False
                         return  # engine aborted the pipeline
                     if tick_s is not None and time.monotonic() - last_tick >= tick_s:
+                        t0 = prof.begin() if prof is not None else None
                         op.handle_tick(self.ctx, self.collector)
+                        if prof is not None:
+                            prof.end("tick", t0)
                         last_tick = time.monotonic()
+                    if prof is not None:
+                        # idle wait: the throttled state-gauge/late-row sweep
+                        prof.refresh()
                     if self.n_inputs == 0 or len(finished) == self.n_inputs:
                         break
                     continue
@@ -327,7 +399,17 @@ class Task:
                 self.metrics.add("arroyo_worker_batches_recv")
                 self.metrics.add("arroyo_worker_messages_recv", item.num_rows)
                 self.metrics.add("arroyo_worker_bytes_recv", item.nbytes())
-                op.process_batch(item, self.ctx, self.collector, input_index=idx)
+                if prof is None:
+                    op.process_batch(item, self.ctx, self.collector, input_index=idx)
+                else:
+                    if self.observe_input_keys and KEY_FIELD in item:
+                        # keyed-insert boundary of the skew sketch
+                        # (shuffling operators feed at the collector's
+                        # shuffle boundary instead — never both)
+                        prof.observe_keys(item.keys)
+                    t0 = prof.begin()
+                    op.process_batch(item, self.ctx, self.collector, input_index=idx)
+                    prof.end("process", t0)
                 if self._terminal and item.num_rows:
                     self._observe_sink_latency(item)
                 self.inbox.release(idx, item)
@@ -371,7 +453,11 @@ class Task:
                 holder.remove(idx)
                 merged_watermark_changed()
                 if len(finished) == self.n_inputs:
+                    t0 = prof.begin() if prof is not None else None
                     op.on_close(self.ctx, self.collector)
+                    if prof is not None:
+                        prof.end("close", t0)
+                        prof.refresh(force=True)
                     self.collector.broadcast(Signal.end_of_data())
                     break
                 # a pending alignment may now be complete

@@ -99,6 +99,10 @@ _HISTOGRAM_NAMES = ("arroyo_worker_emit_batch_rows",
                     "arroyo_worker_queue_transit_seconds",
                     "arroyo_worker_sink_event_latency_seconds")
 CHECKPOINT_PHASES = ("align", "snapshot", "ack", "commit")
+# self-time categories the task run loop attributes operator work to
+# (ISSUE 7): watermark handling (window closes) counts as "process" —
+# it is data-path work driven by the stream, not bookkeeping
+SELF_TIME_CATEGORIES = ("process", "tick", "close", "checkpoint")
 
 
 class TaskMetrics:
@@ -106,7 +110,9 @@ class TaskMetrics:
 
     __slots__ = ("job_id", "node_id", "subtask", "counters", "queue_size",
                  "queue_rem", "emit_batch_rows", "queue_transit",
-                 "sink_event_latency", "watermark_micros")
+                 "sink_event_latency", "watermark_micros", "self_time",
+                 "self_cpu", "late_rows", "state_rows", "state_bytes",
+                 "sketch", "started_monotonic")
 
     def __init__(self, job_id: str, node_id: str, subtask: int):
         self.job_id = job_id
@@ -126,6 +132,19 @@ class TaskMetrics:
         # per-batch end-to-end event latency.
         self.sink_event_latency = Histogram(SINK_LATENCY_BUCKETS)
         self.watermark_micros: Optional[int] = None
+        # cost attribution (ISSUE 7), written only by the owning task
+        # thread: wall + thread-CPU self-time seconds per category, the
+        # late/expired-row counter, live state-size gauges per table, and
+        # the key-skew sketch (obs.sketch.KeySketch, attached by the task
+        # when profiling is enabled). busy%, cost-per-row, and hot-key
+        # shares are derived at export time — never in the hot path.
+        self.self_time = dict.fromkeys(SELF_TIME_CATEGORIES, 0.0)
+        self.self_cpu = dict.fromkeys(SELF_TIME_CATEGORIES, 0.0)
+        self.late_rows = 0
+        self.state_rows: dict[str, int] = {}
+        self.state_bytes: dict[str, int] = {}
+        self.sketch = None
+        self.started_monotonic = time.monotonic()
 
     def histogram(self, name: str) -> Histogram:
         # explicit mapping: an unknown/typoed name must fail loudly at the
@@ -152,6 +171,9 @@ class TaskMetrics:
             return None
         now_us = time.time() * 1e6 if now_us is None else now_us
         return max(0.0, (now_us - self.watermark_micros) / 1e6)
+
+    def uptime_seconds(self) -> float:
+        return max(1e-9, time.monotonic() - self.started_monotonic)
 
 
 class MetricsRegistry:
@@ -229,6 +251,42 @@ class MetricsRegistry:
             lines.append(
                 f"arroyo_worker_watermark_lag_seconds{{{label}}} {lag:.6f}")
 
+        # cost attribution (ISSUE 7): per-category self-time counters, the
+        # late/expired-row counter, and live state-size gauges per table
+        lines.append("# TYPE arroyo_worker_self_time_seconds counter")
+        lines.append("# TYPE arroyo_worker_self_cpu_seconds counter")
+        for t in tasks:
+            for cat in SELF_TIME_CATEGORIES:
+                if not t.self_time[cat] and not t.self_cpu[cat]:
+                    continue
+                label = (f'job="{t.job_id}",operator="{t.node_id}",'
+                         f'subtask="{t.subtask}",category="{cat}"')
+                lines.append(
+                    f"arroyo_worker_self_time_seconds{{{label}}} "
+                    f"{t.self_time[cat]:.6f}")
+                lines.append(
+                    f"arroyo_worker_self_cpu_seconds{{{label}}} "
+                    f"{t.self_cpu[cat]:.6f}")
+        lines.append("# TYPE arroyo_late_rows_total counter")
+        for t in tasks:
+            if not t.late_rows:
+                continue
+            lines.append(
+                f'arroyo_late_rows_total{{job="{t.job_id}",'
+                f'operator="{t.node_id}",subtask="{t.subtask}"}} '
+                f"{t.late_rows}")
+        lines.append("# TYPE arroyo_state_rows gauge")
+        lines.append("# TYPE arroyo_state_bytes gauge")
+        for t in tasks:
+            for table in sorted(t.state_rows):
+                label = (f'job="{t.job_id}",operator="{t.node_id}",'
+                         f'subtask="{t.subtask}",table="{table}"')
+                lines.append(
+                    f"arroyo_state_rows{{{label}}} {t.state_rows[table]}")
+                lines.append(
+                    f"arroyo_state_bytes{{{label}}} "
+                    f"{t.state_bytes.get(table, 0)}")
+
         def emit_histogram(name: str, label: str, h: Histogram) -> None:
             cum = 0
             for le, c in zip(h.buckets, h.counts):
@@ -262,6 +320,9 @@ class MetricsRegistry:
         breakdown so the controller can merge snapshots from a multi-worker
         set without double-counting (each worker reports its own subtasks;
         union by subtask label is exact)."""
+        from .config import config as _config
+
+        topk = int(_config().get("profile.sketch.topk", 5) or 5)
         now_us = time.time() * 1e6
         out: dict[str, dict] = {}
         for t in self.snapshot():
@@ -273,13 +334,31 @@ class MetricsRegistry:
                            if t.queue_transit.count else None)
             sink_p99 = (round(t.sink_event_latency.quantile(0.99), 3)
                         if t.sink_event_latency.count else None)
-            op["per_subtask"][str(t.subtask)] = {
+            entry = {
                 **{name: t.counters[name] for name in _COUNTER_NAMES},
                 "backpressure": round(t.backpressure(), 4),
                 "watermark_lag_seconds": lag if lag is None else round(lag, 3),
                 "queue_transit_p99_ms": transit_p99,
                 "sink_event_latency_p99_s": sink_p99,
+                # cost attribution (ISSUE 7): busy% and cost-per-row are
+                # derived HERE, at export — never in the hot path
+                "uptime_seconds": round(t.uptime_seconds(), 3),
+                "busy_pct": round(
+                    100.0 * sum(t.self_time.values()) / t.uptime_seconds(), 2),
+                "self_time": {c: round(v, 6) for c, v in t.self_time.items()},
+                "self_cpu": {c: round(v, 6) for c, v in t.self_cpu.items()},
+                "late_rows": t.late_rows,
+                "state_rows": dict(t.state_rows),
+                "state_bytes": dict(t.state_bytes),
             }
+            if t.sketch is not None and t.sketch.total:
+                # fixed-width hex: merges deterministically (merge_topk) and
+                # survives JSON without 64-bit precision loss
+                entry["hot_keys"] = [
+                    {**e, "key": f"{e['key']:016x}"}
+                    for e in t.sketch.topk(topk)]
+                entry["sketch_total"] = t.sketch.total
+            op["per_subtask"][str(t.subtask)] = entry
         return {op: _op_aggregate(m["per_subtask"]) for op, m in out.items()}
 
 
@@ -289,11 +368,16 @@ def _op_aggregate(per_subtask: dict[str, dict]) -> dict:
     about). Rate fields default to 0 and are overwritten by the
     controller's windowed tracker while the job runs, so the field contract
     holds for every consumer (UI charts, `top`)."""
+    # profile fields (self-time sums, worst-subtask busy%, state gauges,
+    # merged hot keys) fold through one shared helper so a multi-worker
+    # union aggregates exactly like a local snapshot
+    from .obs.profile import aggregate_profiles
+
     def _max_opt(key):
         vals = [s[key] for s in per_subtask.values() if s.get(key) is not None]
         return max(vals) if vals else None
 
-    return {
+    out = {
         "subtasks": len(per_subtask),
         **{name: sum(int(s.get(name, 0)) for s in per_subtask.values())
            for name in _COUNTER_NAMES},
@@ -305,7 +389,13 @@ def _op_aggregate(per_subtask: dict[str, dict]) -> dict:
         "queue_transit_p99_ms": _max_opt("queue_transit_p99_ms"),
         "sink_event_latency_p99_s": _max_opt("sink_event_latency_p99_s"),
         "per_subtask": per_subtask,
+        **aggregate_profiles(per_subtask),
     }
+    process_s = (out.get("self_time") or {}).get("process")
+    recv = out.get("arroyo_worker_messages_recv", 0)
+    if process_s and recv:
+        out["self_us_per_row"] = round(process_s * 1e6 / recv, 3)
+    return out
 
 
 def merge_job_metrics(snapshots) -> dict:

@@ -1,12 +1,15 @@
-"""Observability plane: epoch-lifecycle tracing + the live job view.
+"""Observability plane: tracing, cost attribution, and the live job views.
 
 ``obs.trace`` records every checkpoint epoch's span tree (trigger ->
 per-subtask alignment -> snapshot -> ack -> metadata durable -> commit
 fan-out) into a bounded in-memory ring and exports it as Chrome trace-event
-JSON; ``obs.topview`` renders the controller-DB-backed per-operator table
-behind ``python -m arroyo_tpu top``. The watermark-lag gauge, sink
-end-to-end latency, and checkpoint phase histograms live in
-``arroyo_tpu.metrics`` next to the existing task counters.
+JSON; ``obs.profile`` is the runtime cost-attribution layer (per-operator
+self-time, state-size gauges, key-skew sketches via ``obs.sketch``, the
+``/profile`` snapshot, and the EXPLAIN ANALYZE renderer behind
+``python -m arroyo_tpu explain``); ``obs.topview`` renders the
+controller-DB-backed per-operator table behind ``python -m arroyo_tpu
+top``. The watermark-lag gauge, sink end-to-end latency, and checkpoint
+phase histograms live in ``arroyo_tpu.metrics`` next to the task counters.
 """
 
 from .trace import (  # noqa: F401 - public API

@@ -14,30 +14,9 @@ import json
 from typing import Optional
 
 from . import trace
-
-
-def _fmt_rate(v) -> str:
-    if v is None:
-        return "-"
-    v = float(v)
-    if v >= 1e6:
-        return f"{v / 1e6:.2f}M"
-    if v >= 1e3:
-        return f"{v / 1e3:.1f}k"
-    return f"{v:.1f}"
-
-
-def _fmt_secs(v) -> str:
-    if v is None:
-        return "-"
-    v = float(v)
-    if v >= 3600:
-        return f"{v / 3600:.1f}h"
-    if v >= 60:
-        return f"{v / 60:.1f}m"
-    if v >= 1:
-        return f"{v:.2f}s"
-    return f"{v * 1e3:.1f}ms"
+from .fmt import fmt_bytes as _fmt_bytes
+from .fmt import fmt_rate as _fmt_rate
+from .fmt import fmt_secs as _fmt_secs
 
 
 def last_epoch_line(checkpoints: list[dict]) -> Optional[str]:
@@ -64,8 +43,8 @@ def last_epoch_line(checkpoints: list[dict]) -> Optional[str]:
     return None
 
 
-_COLUMNS = ("operator", "sub", "in/s", "out/s", "backpr",
-            "transit p99", "wm lag", "sink p99")
+_COLUMNS = ("operator", "sub", "in/s", "out/s", "busy%", "backpr",
+            "transit p99", "wm lag", "sink p99", "state", "late", "hot key")
 
 
 def render(job: dict, metrics: Optional[dict],
@@ -83,15 +62,28 @@ def render(job: dict, metrics: Optional[dict],
         if not isinstance(m, dict):
             continue
         p99 = m.get("queue_transit_p99_ms")
+        busy = m.get("busy_pct")
+        srows = m.get("state_rows") or {}
+        sbytes = m.get("state_bytes") or {}
+        state = ("-" if not srows else
+                 f"{sum(srows.values()):,}r/"
+                 f"{_fmt_bytes(sum(sbytes.values()))}")
+        hot = (m.get("hot_keys") or [{}])[0]
+        hot_s = (f"{hot['key'][:6]}.. {100 * hot.get('share', 0):.0f}%"
+                 if hot.get("key") else "-")
         rows.append((
             op,
             str(m.get("subtasks", len(m.get("per_subtask", {})) or 1)),
             _fmt_rate(m.get("messages_recv_per_sec")),
             _fmt_rate(m.get("messages_per_sec")),
+            "-" if busy is None else f"{float(busy):.1f}",
             f"{float(m.get('backpressure', 0.0)):.2f}",
             "-" if p99 is None else f"{float(p99):.1f}ms",
             _fmt_secs(m.get("watermark_lag_seconds")),
             _fmt_secs(m.get("sink_event_latency_p99_s")),
+            state,
+            str(int(m.get("late_rows") or 0)),
+            hot_s,
         ))
     widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
               for i, c in enumerate(_COLUMNS)]

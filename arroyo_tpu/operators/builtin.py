@@ -147,7 +147,7 @@ class WatermarkGenerator(Operator):
         self.latency_log: Optional[list] = cfg.get("latency_log")
         self.max_watermark: Optional[int] = None
         self.last_emitted: Optional[int] = None
-        self.last_event_wall: float = time.monotonic()
+        self.last_event_wall: float = time.monotonic()  # lint: waive LR109 — event-time idle detection needs a wall clock, not self-measurement
         self.idle_sent = False
 
     def tables(self):
@@ -166,7 +166,7 @@ class WatermarkGenerator(Operator):
     def handle_tick(self, ctx, collector):
         if self.idle_time_micros is None or self.idle_sent:
             return
-        if (time.monotonic() - self.last_event_wall) * 1e6 >= self.idle_time_micros:
+        if (time.monotonic() - self.last_event_wall) * 1e6 >= self.idle_time_micros:  # lint: waive LR109 — idle-watermark timeout is wall-clock by definition
             from ..types import Signal
 
             collector.broadcast(Signal.watermark_of(Watermark.idle()))
@@ -176,7 +176,7 @@ class WatermarkGenerator(Operator):
         n = batch.num_rows
         vals = np.asarray(eval_expr(self.expr, batch.columns, n))
         m = int(vals.max())
-        self.last_event_wall = time.monotonic()
+        self.last_event_wall = time.monotonic()  # lint: waive LR109 — idle-detection clock, not self-measurement
         self.idle_sent = False
         collector.collect(batch)
         if self.max_watermark is None or m > self.max_watermark:
@@ -186,7 +186,7 @@ class WatermarkGenerator(Operator):
                 from ..types import Signal
 
                 if self.latency_log is not None:
-                    self.latency_log.append((m, time.monotonic()))
+                    self.latency_log.append((m, time.monotonic()))  # lint: waive LR109 — bench latency probe stamps injection wall time by design
                 collector.broadcast(Signal.watermark_of(Watermark.event_time(m)))
 
     def handle_checkpoint(self, barrier, ctx, collector):

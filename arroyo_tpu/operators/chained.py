@@ -90,6 +90,23 @@ class ChainedOperator(Operator):
     def name(self) -> str:
         return "+".join(m.name() for m in self.members)
 
+    @property
+    def late_rows(self) -> int:
+        """Chain-wide late/expired-row drops (obs/profile.py exports this
+        per task, so a chain reports its members' sum)."""
+        return sum(int(getattr(m, "late_rows", 0) or 0) for m in self.members)
+
+    def state_sizes(self) -> dict[str, tuple[int, int]]:
+        """Members' live-store gauges, namespaced like their state tables
+        (PrefixedTables uses the same ``c{i}.`` prefix)."""
+        out: dict[str, tuple[int, int]] = {}
+        for i, m in enumerate(self.members):
+            fn = getattr(m, "state_sizes", None)
+            if fn is not None:
+                for name, v in fn().items():
+                    out[f"c{i}.{name}"] = v
+        return out
+
     def tables(self):
         specs = []
         for i, m in enumerate(self.members):

@@ -83,7 +83,7 @@ class Collector:
             self._route(batch)  # already full-size: skip the copy
             return
         if not self._pending:
-            self._pending_since = time.monotonic()
+            self._pending_since = time.monotonic()  # lint: waive LR109 — coalescing max-delay deadline clock, not self-measurement
             self._pending_cols = frozenset(batch.columns)
         self._pending.append(batch)
         self._pending_rows += batch.num_rows
@@ -103,6 +103,7 @@ class Collector:
     def flush_expired(self, now: float | None = None) -> None:
         """Time-based flush: called from the task run loop between items so
         a lull in traffic cannot hold sub-threshold rows forever."""
+        # lint: waive LR109 — coalescing max-delay deadline clock, not self-measurement
         if self._pending and (now or time.monotonic()) - self._pending_since \
                 >= self.co_max_delay_s:
             self.flush()
@@ -137,6 +138,12 @@ class Collector:
 
     def _shuffle_keyed(self, batch: Batch, edge: OutEdge) -> None:
         n = len(edge.dests)
+        if self.metrics is not None and self.metrics.sketch is not None:
+            # key-skew sketch, producer side: the shuffle boundary is where
+            # a hot key melts one downstream subtask (obs/sketch.py); at the
+            # default sample-every=1 this is row-deterministic under replay
+            # no matter how coalescing re-draws batch boundaries
+            self.metrics.sketch.observe(batch.keys)
         from .. import native
 
         part = native.partition(batch.keys, n)

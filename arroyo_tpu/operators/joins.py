@@ -491,6 +491,10 @@ class JoinWithExpiration(Operator):
         self.ttl = int(cfg.get("ttl_micros", 24 * 3600 * 1_000_000))
         self.stores: tuple[_SideStore, _SideStore] = (
             _SideStore(len(self.left_names)), _SideStore(len(self.right_names)))
+        # TTL-expired buffered rows dropped from the side stores, exported
+        # as arroyo_late_rows_total (counting only — expiry semantics are
+        # unchanged)
+        self.late_rows = 0
 
     def tables(self):
         return [
@@ -503,6 +507,21 @@ class JoinWithExpiration(Operator):
         return self.join_type == "full" or self.join_type == (
             "left" if side == 0 else "right"
         )
+
+    def state_sizes(self) -> dict[str, tuple[int, int]]:
+        """Live rows + approximate bytes per side store (obs/profile.py
+        state gauges): between barriers the host tables lag this columnar
+        state, so the live view overrides them."""
+        out: dict[str, tuple[int, int]] = {}
+        for side, name in ((0, "left"), (1, "right")):
+            store = self.stores[side]
+            live = store.n - store.n_dead
+            # keys/ts/match_count int64 lanes + two bool lanes + one object
+            # pointer per value column (payload bytes live behind pointers;
+            # the gauge is a floor, which is the safe direction for spill)
+            per_row = 8 * (3 + len(store.vals)) + 2
+            out[name] = (live, live * per_row)
+        return out
 
     def _src_names(self, side: int) -> list[tuple[str, str]]:
         return self.left_names if side == 0 else self.right_names
@@ -674,6 +693,7 @@ class JoinWithExpiration(Operator):
                 continue
             expired = live[store.ts[live] < cutoff]
             if len(expired):
+                self.late_rows += len(expired)
                 store.kill(expired)
                 live = store.live_ids()
             if len(live):
@@ -755,7 +775,7 @@ class LookupJoin(Operator):
             tuple(c[i] for c in key_cols) if len(key_cols) > 1 else key_cols[0][i]
             for i in range(n)
         ]
-        now = int(_time.time() * 1e6)
+        now = int(_time.time() * 1e6)  # lint: waive LR109 — lookup-cache TTL wall clock, not self-measurement
         # resolve hits AT SUBMIT TIME: deferred emission must not depend on
         # cache entries that a later eviction sweep could remove
         resolved: dict = {}
@@ -806,7 +826,7 @@ class LookupJoin(Operator):
             collector.broadcast(Signal.watermark_of(entry[1]))
             return
         _tag, batch, keys, resolved, missing, fut, borrowed = entry
-        now = int(_time.time() * 1e6)
+        now = int(_time.time() * 1e6)  # lint: waive LR109 — lookup-cache TTL wall clock, not self-measurement
         val_of = dict(resolved)
         if fut is not None:
             fetched = fut.result()

@@ -31,13 +31,13 @@ def wait_buffers_ready(bufs, deadline_s: float = 30.0) -> None:
     materializes in 0.1 ms once the copy lands. Bounded: past the deadline
     the caller's blocking asarray still raises if the device/link actually
     failed (a bare poll loop would spin forever on a dead tunnel)."""
-    limit = time.monotonic() + deadline_s
+    limit = time.monotonic() + deadline_s  # lint: waive LR109 — device-fetch wait deadline, not self-measurement
     try:
         for buf in bufs:
             if buf is None:
                 continue
             while not buf.is_ready():
-                if time.monotonic() > limit:
+                if time.monotonic() > limit:  # lint: waive LR109 — device-fetch wait deadline, not self-measurement
                     return
                 time.sleep(0.0002)
     except AttributeError:

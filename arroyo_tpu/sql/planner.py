@@ -1390,3 +1390,32 @@ def set_parallelism(graph: Graph, n: int) -> None:
         ) and not node.config.get("key_fields") and not node.config.get("partition_fields"):
             continue  # global stage must stay single-instance
         node.parallelism = n
+
+
+def executed_graph_view(sql: str, parallelism: int = 1,
+                        connection_tables: Optional[list[dict]] = None
+                        ) -> tuple[list[dict], list[dict]]:
+    """The plan as the engine EXECUTES it — parallelism applied, Forward
+    runs fused when ``pipeline.chaining.enabled`` — as plain node/edge
+    dicts (the ``/pipelines/<id>/graph`` payload shape). Runtime metrics
+    and the cost profile key by the executed graph's node ids (``"a+b"``
+    for a chained run), so every plan-annotating consumer (the graph API
+    endpoint, ``explain``) must derive its view here or its ids drift from
+    the ones the runtime reports."""
+    pp = plan_query(sql, connection_tables=connection_tables)
+    if parallelism > 1:
+        set_parallelism(pp.graph, parallelism)
+    g = pp.graph
+    from ..config import config as _cfg
+
+    if _cfg().get("pipeline.chaining.enabled"):
+        from ..optimizer import chain_graph
+
+        g = chain_graph(g)
+    nodes = [{"id": n.node_id, "op": n.op.value,
+              "description": n.description or n.op.value,
+              "parallelism": n.parallelism}
+             for n in g.nodes.values()]
+    edges = [{"src": e.src, "dst": e.dst, "type": e.edge_type.value}
+             for e in g.edges]
+    return nodes, edges

@@ -468,6 +468,12 @@ def _probe_default_platform(attempts: int = 4, retry_delay_s: float = 30.0) -> s
 
 
 def main() -> None:
+    # --profile: embed the per-operator cost profile (self-time, busy%,
+    # state sizes, hot keys — obs/profile.py, same data `explain` renders)
+    # under extra.<cfg>.profile so future perf PRs can attribute wins per
+    # operator straight from the BENCH_*.json archive. Taken from the LAST
+    # rep (run_config clears the registry per rep).
+    embed_profile = "--profile" in sys.argv[1:]
     platform = None
     if os.environ.get("ARROYO_BENCH_PLATFORM"):
         platform = os.environ["ARROYO_BENCH_PLATFORM"]
@@ -580,6 +586,12 @@ def main() -> None:
                 "sink_event_latency_s": histogram_summary(sk),
             },
         }
+        if embed_profile:
+            from arroyo_tpu.metrics import registry as _registry
+            from arroyo_tpu.obs.profile import job_profile
+
+            extra[name]["profile"] = job_profile(
+                _registry.job_metrics(f"bench-{name}-jax"))
         budget = P99_BUDGET_MS.get(name)
         if budget is not None:
             # judged on the WORST rep: one blown rep is a blown budget; an

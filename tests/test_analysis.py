@@ -456,6 +456,35 @@ def test_lr108_bare_print():
     assert "LR108" not in ids_of(lint_source(waived, "arroyo_tpu/engine/x.py"))
 
 
+def test_lr109_adhoc_self_timing():
+    bad = (
+        "import time\n"
+        "def process_batch(self, batch, ctx, collector, input_index=0):\n"
+        "    t0 = time.perf_counter()\n"
+        "    work(batch)\n"
+        "    self.total += time.time() - t0\n"
+    )
+    # self-measurement in operator/window/state code fragments attribution
+    for rel in ("arroyo_tpu/operators/x.py", "arroyo_tpu/windows/x.py",
+                "arroyo_tpu/state/x.py", "arroyo_tpu/ops/x.py"):
+        assert "LR109" in ids_of(lint_source(bad, rel)), rel
+    # the engine/profiler layers OWN the stopwatch; connectors poll clocks
+    assert "LR109" not in ids_of(lint_source(bad, "arroyo_tpu/engine/x.py"))
+    assert "LR109" not in ids_of(lint_source(bad, "arroyo_tpu/obs/profile.py"))
+    assert "LR109" not in ids_of(lint_source(bad, "arroyo_tpu/connectors/x.py"))
+    # time.sleep is not a clock read (LR101/LR105 cover sleeps)
+    sleepy = "import time\ndef handle_tick(self, ctx, c):\n    time.sleep(0.1)\n"
+    assert "LR109" not in ids_of(lint_source(sleepy, "arroyo_tpu/operators/x.py"))
+    # a justified waiver records WHY a clock read is not self-measurement
+    waived = bad.replace(
+        "t0 = time.perf_counter()",
+        "t0 = time.perf_counter()  # lint: waive LR109 — cache TTL wall clock"
+    ).replace(
+        "self.total += time.time() - t0",
+        "self.total += time.time() - t0  # lint: waive LR109 — cache TTL wall clock")
+    assert "LR109" not in ids_of(lint_source(waived, "arroyo_tpu/operators/x.py"))
+
+
 def test_waivers():
     bad = (
         "def f():\n"

@@ -464,6 +464,22 @@ def test_process_scheduler_two_worker_set(tmp_path, _storage):
         assert any(
             {(op, "0"), (op, "1")} <= labels for op, _ in labels
         ), f"no operator carries both workers' subtask labels: {sorted(labels)}"
+        # merged /profile view (ISSUE 7): the persisted cost profile carries
+        # BOTH workers' subtasks per operator with attributed self-time, and
+        # the EXPLAIN ANALYZE renderer annotates the plan from it
+        prof = db.get_profile(jid) or {}
+        prof_labels = {(op, sub) for op, p in prof.items()
+                       for sub in p.get("per_subtask", {})}
+        two_sided = [op for op, _ in prof_labels
+                     if {(op, "0"), (op, "1")} <= prof_labels]
+        assert two_sided, (
+            f"merged profile lacks a both-workers operator: {sorted(prof_labels)}")
+        assert any(sum((p.get("self_time") or {}).values()) > 0
+                   for p in prof.values()), "profile has no attributed self-time"
+        from arroyo_tpu.obs.profile import render_explain
+
+        text = render_explain([], [], prof, db.get_job(jid))
+        assert f"EXPLAIN ANALYZE job {jid}" in text and "busy" in text
         # the workers relayed their epoch span events; the controller
         # persisted whole-job trace timelines with both workers' acks
         traces = db.list_traces(jid)

@@ -9,6 +9,16 @@ Asserts three things the coalescing work must keep true:
     de-coalescing (per-window or per-key tiny emits sneaking back into the
     emission path) multiplies the batch count long before it shows up in
     wall-clock numbers.
+
+Plus (ISSUE 7) the profiler overhead guard: cost attribution is on by
+default in production, so the run-loop wrapping must stay under 5% wall
+on the same smoke-scale pipelines.
+
+Container-throttling calibration: the ROADMAP notes bench numbers swing
+>2x with CPU throttling, so every budget here is judged only after a fixed
+numpy kernel confirms the box runs within 2x of the recorded warm-box
+constant — on a colder box the whole module SKIPS with the measured
+slowdown in the reason (budget failures there are pure noise, not signal).
 """
 
 from __future__ import annotations
@@ -24,8 +34,44 @@ pytestmark = pytest.mark.slow
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# best-of-3 seconds for _calibration_kernel on the warm box these budgets
+# were recorded on (2-core container, idle); re-record alongside any budget
+# change
+WARM_BOX_CALIBRATION_S = 0.09
+MAX_SLOWDOWN = 2.0
 
-def _run(build, events, batch_size, queue_mult):
+
+def _calibration_kernel() -> float:
+    """Fixed numpy workload (BLAS matmul + sort — the same primitives the
+    engine hot paths lean on); wall seconds, best of 3."""
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((512, 512))
+
+    def once() -> float:
+        t0 = time.perf_counter()
+        for _ in range(6):
+            b = a @ a
+            np.sort(b, axis=0)
+        return time.perf_counter() - t0
+
+    return min(once() for _ in range(3))
+
+
+_slowdown: float | None = None
+
+
+def _require_warm_box() -> None:
+    global _slowdown
+    if _slowdown is None:
+        _slowdown = _calibration_kernel() / WARM_BOX_CALIBRATION_S
+    if _slowdown > MAX_SLOWDOWN:
+        pytest.skip(
+            f"box runs {_slowdown:.1f}x slower than the warm-box calibration "
+            f"constant ({WARM_BOX_CALIBRATION_S}s kernel): container CPU "
+            "throttling makes wall-clock budgets pure noise here")
+
+
+def _run(build, events, batch_size, queue_mult, job_id="perf-guard"):
     import bench
 
     from arroyo_tpu import config as cfg
@@ -40,13 +86,14 @@ def _run(build, events, batch_size, queue_mult):
     rows: list = []
     g = build(rows, "jax", events, [], [])
     t0 = time.perf_counter()
-    run_graph(g, job_id="perf-guard", timeout=600)
+    run_graph(g, job_id=job_id, timeout=600)
     return time.perf_counter() - t0, rows
 
 
 def test_q8_scaled_parity_throughput_and_batch_count(_storage):
     import bench
 
+    _require_warm_box()
     events, batch = 120_000, 8192
     wall, rows = _run(bench.build_q8, events, batch, 1)
     n_rows = bench.check_parity_q8(rows, events)
@@ -66,6 +113,7 @@ def test_q8_scaled_parity_throughput_and_batch_count(_storage):
 def test_q5_scaled_parity_throughput_and_batch_count(_storage):
     import bench
 
+    _require_warm_box()
     events, batch = 200_000, 8192
     wall, rows = _run(bench.build_q5, events, batch, 2)
     total = bench.check_parity_q5(rows, events)
@@ -81,3 +129,42 @@ def test_q5_scaled_parity_throughput_and_batch_count(_storage):
         f"is de-coalesced")
     mean_rows = sum(b.num_rows for b in rows) / len(rows)
     assert mean_rows >= 64, f"mean emit batch of {mean_rows:.0f} rows"
+
+
+def test_profiler_overhead_under_5pct(_storage):
+    """Cost attribution (obs/profile.py) ships on by default, so the
+    self-time wrapping + sketch feed must be noise on a real pipeline.
+    Interleaved best-of-3 per mode on smoke-scale q5 decorrelates slow
+    box drift from the on/off comparison; a small absolute epsilon covers
+    the timer's noise floor at ~1s run lengths."""
+    import bench
+
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.metrics import registry
+
+    _require_warm_box()
+    events, batch = 100_000, 8192
+    best = {True: float("inf"), False: float("inf")}
+    try:
+        # one throwaway warm run so jit/window compiles don't land on the
+        # first measured mode
+        _run(bench.build_q5, events, batch, 2, job_id="prof-ovh-warm")
+        for _rep in range(3):
+            for enabled in (False, True):
+                cfg.update({"profile.enabled": enabled})
+                registry.clear_job("prof-ovh")
+                wall, rows = _run(bench.build_q5, events, batch, 2,
+                                  job_id="prof-ovh")
+                bench.check_parity_q5(rows, events)
+                best[enabled] = min(best[enabled], wall)
+    finally:
+        cfg.update({"profile.enabled": True})
+    overhead = best[True] / best[False] - 1.0
+    assert best[True] <= best[False] * 1.05 + 0.10, (
+        f"profiling overhead {overhead * 100:.1f}% "
+        f"(on {best[True]:.3f}s vs off {best[False]:.3f}s) exceeds the 5% "
+        "budget: the run-loop wrapping or sketch feed got expensive")
+    # and the profiled run actually attributed the cost somewhere
+    jm = registry.job_metrics("prof-ovh")
+    assert any(sum((m.get("self_time") or {}).values()) > 0
+               for m in jm.values()), "profiling on but no self-time recorded"

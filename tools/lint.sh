@@ -24,8 +24,12 @@ if [[ "${1:-}" == "--metrics-catalog" ]]; then
 import glob, re, sys
 
 # every prometheus series family this codebase can emit (string literals
-# in the package; _bucket/_sum/_count suffixes are format-time derived)
-NAME_RE = re.compile(r"arroyo_(?:worker|checkpoint)_[a-z0-9_]+")
+# in the package; _bucket/_sum/_count suffixes are format-time derived).
+# state/late families (ISSUE 7) carry no worker_ prefix — they describe
+# job-level facts, not worker-loop counters — so they match explicitly
+NAME_RE = re.compile(r"arroyo_(?:worker|checkpoint)_[a-z0-9_]+"
+                     r"|arroyo_state_(?:rows|bytes)"
+                     r"|arroyo_late_rows_total")
 code_names: set[str] = set()
 for p in glob.glob("arroyo_tpu/**/*.py", recursive=True):
     with open(p) as f:
