@@ -50,6 +50,17 @@ Rule catalog:
                                Legitimate wall-clock uses (cache TTLs,
                                event-time idle detection, coalescing
                                deadlines) carry waivers naming the reason
+    LR110 logger-in-function   ``logging.getLogger("name")`` inside a
+                               function body: acquire the module's logger
+                               ONCE at module level (``_log = logging.
+                               getLogger(...)``) — per-call acquisition
+                               hides the logger from level configuration
+                               audits, re-pays the registry lookup on hot
+                               error paths, and encourages the inline
+                               ``import logging`` that shadows the
+                               structured-events bridge setup. Bare
+                               ``logging.getLogger()`` (the root logger,
+                               used by logging-INIT code) is exempt
 
 Waivers: append ``# lint: waive LR1xx — justification`` on the flagged
 line (or the line above). A waiver with no justification text does not
@@ -468,6 +479,33 @@ def rule_lr109(mod: ModuleInfo) -> Iterable[Finding]:
                    "deadline), waive with the reason")
 
 
+def rule_lr110(mod: ModuleInfo) -> Iterable[Finding]:
+    """Named logger acquisition inside a function body. The package's
+    convention is one module-level ``_log = logging.getLogger(...)``;
+    inline acquisition (found twice in controller.py before this rule)
+    drifts into per-call ``import logging`` blocks and makes the set of
+    logger names impossible to audit statically."""
+    if not mod.relpath.startswith("arroyo_tpu/"):
+        return
+    seen: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in ast.walk(node):
+            if (isinstance(n, ast.Call) and _call_name(n) == "getLogger"
+                    and _receiver_name(n) == "logging"
+                    and (n.args or n.keywords)  # bare root-logger is exempt
+                    and n.lineno not in seen):
+                seen.add(n.lineno)
+                yield (n.lineno,
+                       "logging.getLogger(...) inside a function body: "
+                       "loggers are acquired once at module level in this "
+                       "package, so names stay statically auditable and "
+                       "hot error paths skip the registry lookup",
+                       "hoist to a module-level `_log = logging."
+                       "getLogger(\"arroyo_tpu...\")` and use _log here")
+
+
 RULES: tuple[tuple[str, Severity, object], ...] = (
     ("LR101", Severity.ERROR, rule_lr101),
     ("LR102", Severity.ERROR, rule_lr102),
@@ -478,6 +516,7 @@ RULES: tuple[tuple[str, Severity, object], ...] = (
     ("LR107", Severity.ERROR, rule_lr107),
     ("LR108", Severity.ERROR, rule_lr108),
     ("LR109", Severity.ERROR, rule_lr109),
+    ("LR110", Severity.ERROR, rule_lr110),
 )
 
 # fault sites every full-package lint must find wired (mirrors faults.SITES;

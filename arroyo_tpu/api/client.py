@@ -123,6 +123,27 @@ class ArroyoClient:
         suffix = f"?{'&'.join(q)}" if q else ""
         return self._req("GET", f"/api/v1/jobs/{job_id}/traces{suffix}")
 
+    def job_events(self, job_id: str, level: Optional[str] = None,
+                   since: Optional[float] = None,
+                   after: Optional[int] = None) -> dict:
+        """Structured job event feed (operator panics, restores, wedged
+        epochs, health transitions); ``after`` is the seq cursor for
+        incremental tailing."""
+        q = []
+        if level is not None:
+            q.append(f"level={level}")
+        if since is not None:
+            q.append(f"since={since}")
+        if after is not None:
+            q.append(f"after={after}")
+        suffix = f"?{'&'.join(q)}" if q else ""
+        return self._req("GET", f"/api/v1/jobs/{job_id}/events{suffix}")
+
+    def job_health(self, job_id: str) -> dict:
+        """Job health (ok/degraded/critical) with per-rule observed value,
+        threshold, and firing flag."""
+        return self._req("GET", f"/api/v1/jobs/{job_id}/health")
+
     def list_connectors(self) -> dict:
         return self._req("GET", "/api/v1/connectors")
 

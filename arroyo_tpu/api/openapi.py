@@ -49,6 +49,34 @@ JOB = {
         "id": _STR, "pipeline_id": _STR, "state": _STR,
         "restarts": _INT, "checkpoint_epoch": _INT,
         "n_workers": _INT,  # size of the job's running worker set
+        # ok | degraded | critical (controller health monitors)
+        "health": _STR,
+    },
+}
+JOB_EVENT = {
+    "type": "object",
+    "properties": {
+        "seq": _INT, "ts_us": _INT,
+        "level": {"type": "string",
+                  "enum": ["DEBUG", "INFO", "WARN", "ERROR"]},
+        "code": _STR,  # stable EventCode (see README "Events & health")
+        "node": _STR, "subtask": _INT, "worker": _INT, "epoch": _INT,
+        "message": _STR, "data": {"type": "object"},
+    },
+}
+JOB_HEALTH = {
+    "type": "object",
+    "properties": {
+        "job_id": _STR,
+        "state": {"type": "string", "enum": ["ok", "degraded", "critical"]},
+        "rules": {"type": "array", "items": {
+            "type": "object",
+            "properties": {
+                "rule": _STR, "severity": _STR, "description": _STR,
+                "value": {"type": "number"}, "threshold": {"type": "number"},
+                "breaching": {"type": "boolean"},
+                "firing": {"type": "boolean"},
+            }}},
     },
 }
 UDF = {
@@ -161,6 +189,19 @@ def spec() -> dict:
                 "get": _op("job_traces", "checkpoint epoch traces "
                            "(Chrome trace-event JSON; ?format=events for "
                            "raw spans, ?epoch=N to restrict)", ["job_id"])},
+            "/api/v1/jobs/{job_id}/events": {
+                "get": _op("job_events", "structured job event feed "
+                           "(?level= minimum level, ?since= unix seconds, "
+                           "?after= seq cursor for tailing)", ["job_id"],
+                           response={"type": "object", "properties": {
+                               "job_id": _STR,
+                               "data": {"type": "array", "items": JOB_EVENT},
+                           }})},
+            "/api/v1/jobs/{job_id}/health": {
+                "get": _op("job_health", "job health state with per-rule "
+                           "detail (hysteresis-filtered monitors over the "
+                           "merged job metrics)", ["job_id"],
+                           response=JOB_HEALTH)},
             "/api/v1/connectors": {
                 "get": _op("list_connectors", "available connectors")},
             "/api/v1/connection_profiles": {

@@ -107,6 +107,8 @@ class ApiServer:
         ("GET", r"^/api/v1/jobs/([^/]+)/metrics$", "_job_metrics"),
         ("GET", r"^/api/v1/jobs/([^/]+)/profile$", "_job_profile"),
         ("GET", r"^/api/v1/jobs/([^/]+)/traces$", "_job_traces"),
+        ("GET", r"^/api/v1/jobs/([^/]+)/events$", "_job_events"),
+        ("GET", r"^/api/v1/jobs/([^/]+)/health$", "_job_health"),
         ("GET", r"^/api/v1/connectors$", "_connectors"),
         ("POST", r"^/api/v1/connection_profiles$", "_create_profile"),
         ("GET", r"^/api/v1/connection_profiles$", "_list_profiles"),
@@ -400,6 +402,7 @@ class ApiServer:
         restricts either form to one epoch."""
         from urllib.parse import parse_qs
 
+        from ..obs import events as obs_events
         from ..obs import trace as obs_trace
 
         q = parse_qs(h.path.split("?", 1)[1]) if "?" in h.path else {}
@@ -418,7 +421,47 @@ class ApiServer:
             h._json(200, {"job_id": jid, "epochs": {
                 str(e): evs for e, evs in sorted(by_epoch.items())}})
             return
-        h._json(200, obs_trace.chrome_trace(jid, by_epoch))
+        # epoch-scoped job events render as instant markers on the same
+        # timeline, so spans and the event feed correlate in one view
+        job_events = self.db.list_events(jid) or obs_events.recorder.events(jid)
+        h._json(200, obs_trace.chrome_trace(jid, by_epoch,
+                                            job_events=job_events))
+
+    def _job_events(self, h, jid):
+        """Structured job event feed (obs.events): the controller-persisted
+        rows, oldest first. ``?level=WARN`` filters to a minimum level,
+        ``?since=<unix seconds>`` to a wall-time floor, ``?after=<seq>`` is
+        the incremental-tail cursor the `logs --follow` CLI uses. Falls
+        back to the in-process ring for jobs whose controller shares this
+        process and has not flushed yet."""
+        from urllib.parse import parse_qs
+
+        from ..obs import events as obs_events
+
+        q = parse_qs(h.path.split("?", 1)[1]) if "?" in h.path else {}
+        level = q.get("level", [None])[0]
+        since = float(q["since"][0]) if q.get("since") else None
+        after = int(q.get("after", ["0"])[0])
+        data = self.db.list_events(jid, level=level, since=since,
+                                   after_seq=after)
+        if not data:
+            data = obs_events.recorder.events(
+                jid, level=level,
+                since_us=None if since is None else int(since * 1e6),
+                after_seq=after or None)
+        h._json(200, {"job_id": jid, "data": data})
+
+    def _job_health(self, h, jid):
+        """Job health with per-rule detail (obs.health): state plus each
+        rule's observed value, threshold, and firing flag — what the
+        autoscaler (and `top`'s header) read."""
+        job = self.db.get_job(jid)
+        if not job:
+            h._json(404, {"error": "not found"})
+            return
+        detail = self.db.get_health(jid) or {
+            "state": job.get("health") or "ok", "rules": []}
+        h._json(200, {"job_id": jid, **detail})
 
     def _job_metrics(self, h, jid):
         # DB-persisted snapshots (shipped from workers over the control

@@ -16,7 +16,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import queue as _queue_mod
 import signal
 import sys
 import threading
@@ -224,10 +223,10 @@ def _cmd_worker(args) -> int:
         worker_index=int(getattr(args, "worker_index", None) or 0),
         network=network,
     )
-    # relay epoch-lifecycle span events to the controller so ITS trace
-    # recorder (the one behind /traces and the wedge diagnostics) holds
-    # this worker's barrier/snapshot/ack timeline too
-    eng.relay_spans = True
+    # relay epoch-lifecycle spans AND structured job events to the
+    # controller so ITS recorders (behind /traces, /events, and the wedge
+    # diagnostics) hold this worker's timelines and event feed too
+    eng.relay_obs = True
     if n_workers > 1:
         emit({"event": "started", "dp_port": network.port,
               "worker_index": int(args.worker_index or 0)})
@@ -235,7 +234,6 @@ def _cmd_worker(args) -> int:
         eng.start()
         started.set()
         emit({"event": "started"})
-    reported: set[int] = set()
     fatal: list[str] = []
 
     def read_commands() -> None:
@@ -281,28 +279,26 @@ def _cmd_worker(args) -> int:
         with eng._lock:
             done = (started.is_set() and eng._n_tasks
                     and len(eng._finished_tasks) + len(eng._failed) >= eng._n_tasks)
-            completed = sorted(eng._completed_epochs - reported)
             failed = list(eng._failed)
-        # spans first: a subtask_acked that completes global coverage makes
-        # the controller compute phase durations and persist the epoch trace
-        # immediately, so the ack span enqueued alongside it must already be
-        # in the recorder by then
-        while True:
-            try:
-                emit(eng.span_events.get_nowait())
-            except _queue_mod.Empty:
-                break
-        if eng.coordinated:
-            # relay per-subtask acks; the controller declares epochs done
-            while True:
-                try:
-                    emit(eng.coordinator_events.get_nowait())
-                except _queue_mod.Empty:
-                    break
-        else:
-            for ep in completed:
-                reported.add(ep)
-                emit({"event": "checkpoint_completed", "epoch": ep})
+        send_hb = time.monotonic() - last_hb > 1.0
+        if send_hb:
+            # chaos hook: dropping heartbeats (worker.heartbeat:drop) models
+            # a hung-but-not-dead worker; the controller's heartbeat-timeout
+            # detection must declare it lost and recover (metrics ride the
+            # same cadence, so a "hung" worker goes silent on both)
+            from arroyo_tpu.faults import fault_point
+
+            last_hb = time.monotonic()
+            if (fault_point("worker.heartbeat") or (None,))[0] == "drop":
+                send_hb = False
+        # ONE drain for every relay stream — spans, job events, throttled
+        # metrics, coordinator acks / completed epochs. The ordering rules
+        # (spans and events strictly before coordinator acks) live in
+        # Engine.drain_relay, not in per-stream loops here.
+        for ev in eng.drain_relay(include_metrics=send_hb):
+            emit(ev)
+        if send_hb:
+            emit({"event": "heartbeat"})
         lines = take_preview_rows(args.job_id)
         if lines:
             emit({"event": "sink_data", "lines": lines})
@@ -315,18 +311,6 @@ def _cmd_worker(args) -> int:
         if done:
             emit({"event": "finished"})
             return 0
-        if time.monotonic() - last_hb > 1.0:
-            # chaos hook: dropping heartbeats (worker.heartbeat:drop) models
-            # a hung-but-not-dead worker; the controller's heartbeat-timeout
-            # detection must declare it lost and recover
-            from arroyo_tpu.faults import fault_point
-
-            if (fault_point("worker.heartbeat") or (None,))[0] != "drop":
-                emit({"event": "heartbeat"})
-                from arroyo_tpu.metrics import registry as _mreg
-
-                emit({"event": "metrics", "data": _mreg.job_metrics(args.job_id)})
-            last_hb = time.monotonic()
         time.sleep(0.05)
 
 
@@ -340,12 +324,14 @@ def _cmd_trace(args) -> int:
 
     from arroyo_tpu.obs import trace as obs_trace
 
+    job_events: list = []
     if args.db:
         from arroyo_tpu.controller import Database
 
         db = Database(args.db)
         rows = db.list_traces(args.job_id, epoch=args.epoch)
         by_epoch = {r["epoch"]: r["events"] for r in rows}
+        job_events = db.list_events(args.job_id)
     else:
         url = (f"{args.api.rstrip('/')}/api/v1/jobs/{args.job_id}"
                "/traces?format=events")
@@ -355,6 +341,13 @@ def _cmd_trace(args) -> int:
             payload = json.load(r)
         by_epoch = {int(e): evs
                     for e, evs in (payload.get("epochs") or {}).items()}
+        try:
+            with urllib.request.urlopen(
+                    f"{args.api.rstrip('/')}/api/v1/jobs/{args.job_id}"
+                    "/events", timeout=10) as r:
+                job_events = json.load(r).get("data") or []
+        except OSError:
+            job_events = []
     if not by_epoch:
         print(f"no trace events recorded for job {args.job_id}",
               file=sys.stderr)
@@ -363,7 +356,8 @@ def _cmd_trace(args) -> int:
         for e in sorted(by_epoch):
             print(obs_trace.timeline_report(args.job_id, e, by_epoch[e]))
         return 0
-    chrome = obs_trace.chrome_trace(args.job_id, by_epoch)
+    chrome = obs_trace.chrome_trace(args.job_id, by_epoch,
+                                    job_events=job_events)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(chrome, f)
@@ -372,6 +366,78 @@ def _cmd_trace(args) -> int:
     else:
         print(json.dumps(chrome))
     return 0
+
+
+def _cmd_logs(args) -> int:
+    """Per-job structured event feed (obs.events): operator panics, set
+    restores, wedged epochs, commit re-deliveries, rescales, and health
+    transitions, each with its {node, subtask, worker, epoch} scope. Reads
+    the controller DB directly (--db) or the cluster API; --follow tails
+    new events until the job reaches a terminal state."""
+    import urllib.error
+    import urllib.request
+
+    from arroyo_tpu.obs.events import render_event
+
+    db = None
+    if args.db:
+        from arroyo_tpu.controller import Database
+
+        db = Database(args.db)
+
+    # state is the job's FSM state, "missing" for a job id the DB/API does
+    # not know (so --follow can error out instead of tailing a typo
+    # forever), or None when the API state probe transiently failed
+    def fetch(after_seq: int) -> tuple[list[dict], Optional[str]]:
+        if db is not None:
+            job = db.get_job(args.job_id)
+            return (db.list_events(args.job_id, level=args.level,
+                                   after_seq=after_seq),
+                    job["state"] if job else "missing")
+        base = args.api.rstrip("/")
+        url = f"{base}/api/v1/jobs/{args.job_id}/events?after={after_seq}"
+        if args.level:
+            url += f"&level={args.level}"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                payload = json.load(r)
+        except OSError:
+            if not args.follow:
+                raise  # one-shot read: surface the API failure
+            return [], None  # tailing: keep polling through the blip
+        try:
+            with urllib.request.urlopen(
+                    f"{base}/api/v1/jobs/{args.job_id}", timeout=10) as r:
+                state = json.load(r).get("state")
+        except urllib.error.HTTPError as e:
+            state = "missing" if e.code == 404 else None
+        except OSError:
+            state = None
+        return payload.get("data") or [], state
+
+    last_seq = 0
+    printed = 0
+    while True:
+        events, state = fetch(last_seq)
+        for ev in events:
+            print(render_event(ev))
+            last_seq = max(last_seq, int(ev.get("seq") or 0))
+            printed += 1
+        if state == "missing" and not printed:
+            print(f"no such job {args.job_id}", file=sys.stderr)
+            return 1
+        if not args.follow:
+            if not printed:
+                print(f"no events recorded for job {args.job_id}",
+                      file=sys.stderr)
+                return 1
+            return 0
+        if state in ("Failed", "Finished", "Stopped", "missing"):
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
 
 
 def _cmd_explain(args) -> int:
@@ -627,6 +693,23 @@ def main(argv: Optional[list[str]] = None) -> int:
     op.add_argument("--once", action="store_true",
                     help="print one frame and exit (no screen clearing)")
     op.set_defaults(fn=_cmd_top)
+
+    lg = sub.add_parser("logs", help="structured job event feed (operator "
+                                     "panics, restores, wedged epochs, "
+                                     "health transitions)")
+    lg.add_argument("job_id")
+    lg.add_argument("--api", default="http://127.0.0.1:5115",
+                    help="cluster API base url")
+    lg.add_argument("--db", default=None,
+                    help="read the controller DB file directly instead")
+    lg.add_argument("--level", default=None,
+                    choices=["DEBUG", "INFO", "WARN", "ERROR"],
+                    help="minimum level to show")
+    lg.add_argument("--follow", "-f", action="store_true",
+                    help="keep tailing new events until the job ends")
+    lg.add_argument("--interval", type=float, default=1.0,
+                    help="--follow poll period seconds")
+    lg.set_defaults(fn=_cmd_logs)
 
     ep = sub.add_parser("explain", help="EXPLAIN ANALYZE: the logical plan "
                                         "annotated with live per-operator "

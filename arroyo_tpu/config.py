@@ -109,6 +109,33 @@ _DEFAULTS: dict[str, Any] = {
             "topk": 5,           # hot keys exported per operator
         },
     },
+    "health": {
+        # controller-side health monitors (obs/health.py): rules evaluated
+        # every supervision tick over the merged job metrics, with
+        # hysteresis — fire after fire-ticks consecutive breaching ticks,
+        # clear after clear-ticks healthy ones (no flapping on a metric
+        # oscillating around its threshold)
+        "enabled": True,
+        "fire-ticks": 3,
+        "clear-ticks": 5,
+        "watermark-lag-max-s": 900.0,
+        "backpressure-max": 0.9,
+        "queue-transit-p99-max-ms": 1000.0,
+        "sink-latency-p99-max-s": 600.0,
+        "checkpoint-failure-streak": 2,
+    },
+    "obs": {
+        # structured job event log (obs/events.py): bounded per-job ring
+        "events": {"max-per-job": 512},
+    },
+    "logging": {
+        # reference [logging] section: console | json | logfmt
+        "format": "console",
+        "level": "INFO",
+        # install the JobEvent bridge handler: stdlib log records carrying
+        # job context (extra={"job_id": ...}) land in the job event feed
+        "capture-events": False,
+    },
     "api": {"http-port": 5115},
     "admin": {"http-port": 5114},
 }

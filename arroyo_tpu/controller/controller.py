@@ -18,6 +18,7 @@ globally complete checkpoint.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import traceback
@@ -25,10 +26,14 @@ from typing import Optional
 
 from ..config import config
 from ..obs import trace as obs_trace
+from ..obs.events import recorder as events_recorder
+from ..obs.health import HealthMonitor, health_event_code
 from ..state.tables import latest_complete_checkpoint
 from .db import Database
 from .scheduler import Scheduler, WorkerHandle, scheduler_for
 from .states import JobState, check_transition
+
+_log = logging.getLogger("arroyo_tpu.controller")
 
 
 class JobController:
@@ -76,6 +81,70 @@ class JobController:
         from ..metrics import RateTracker
 
         self.rates = RateTracker(window_s=10.0)
+        # the autoscaler's sensor layer (obs/health.py): rule set with
+        # hysteresis evaluated every supervision tick over the latest
+        # merged metrics snapshot; transitions emit HEALTH_* events
+        self.health = HealthMonitor(job_id,
+                                    on_transition=self._on_health_transition)
+        self._last_merged_metrics: Optional[dict] = None
+        self._last_health_persist = 0.0
+        # job event log: incremental flush cursor into the job_events table.
+        # A restarted controller re-adopting the job seeds both the cursor
+        # and the in-memory ring's seq counter from the DB's max persisted
+        # seq, or every post-restart event would collide with an existing
+        # (job, seq) row and be silently dropped by the idempotent flush
+        self._events_flushed_seq = self.db.last_event_seq(job_id)
+        events_recorder.ensure_seq_floor(job_id, self._events_flushed_seq)
+
+    def _event(self, level: str, code: str, message: str, **kw) -> None:
+        events_recorder.record(self.job_id, level, code, message, **kw)
+
+    def _flush_events(self) -> None:
+        """Persist job events recorded (or ingested from workers) since the
+        last flush — runs every step so the DB feed trails the ring by at
+        most one supervision tick. The cursor advances only AFTER a
+        successful write (a transient DB error retries the same events next
+        tick instead of silently dropping them), and a failed flush must
+        not take the supervision loop down with it."""
+        evs = events_recorder.events(self.job_id,
+                                     after_seq=self._events_flushed_seq)
+        if not evs:
+            return
+        try:
+            self.db.record_events(self.job_id, evs)
+        except Exception:  # noqa: BLE001 - feed durability is best-effort
+            _log.exception("job-event flush failed for %s; retrying next "
+                           "tick", self.job_id)
+            return
+        self._events_flushed_seq = evs[-1]["seq"]
+
+    def _on_health_transition(self, old: str, new: str, detail: dict) -> None:
+        firing = [{"rule": r["rule"], "value": r["value"],
+                   "threshold": r["threshold"]}
+                  for r in detail["rules"] if r["firing"]]
+        code = health_event_code(new)
+        level = {"HEALTH_OK": "INFO", "HEALTH_DEGRADED": "WARN",
+                 "HEALTH_CRITICAL": "ERROR"}[code]
+        names = ", ".join(f["rule"] for f in firing) or "all rules clear"
+        self._event(level, code, f"health {old} -> {new} ({names})",
+                    data={"firing": firing})
+        self.db.update_job(self.job_id, health=new)
+        self.db.record_health(self.job_id, new, detail)
+
+    def _eval_health(self) -> None:
+        if not config().get("health.enabled", True):
+            return
+        detail = self.health.evaluate(self._last_merged_metrics,
+                                      ckpt_failures=self._ckpt_failures)
+        from ..metrics import registry as metrics_registry
+
+        metrics_registry.set_job_health(self.job_id, self.health.state)
+        # transitions persist immediately (_on_health_transition); between
+        # them, refresh the per-rule observed values at ~1 Hz for /health
+        now = time.monotonic()
+        if now - self._last_health_persist >= 1.0:
+            self._last_health_persist = now
+            self.db.record_health(self.job_id, self.health.state, detail)
 
     # -- single-worker compatibility surface ---------------------------
 
@@ -110,6 +179,9 @@ class JobController:
         except Exception:  # noqa: BLE001 - job failure, not controller crash
             self.failure = traceback.format_exc()
             self._fail(self.failure)
+        finally:
+            # event-feed durability trails the ring by at most one tick
+            self._flush_events()
 
     def _kill_all(self) -> None:
         for h in self.handles:
@@ -158,6 +230,11 @@ class JobController:
                 self._fail(f"exceeded allowed-restarts={restarts_allowed}: {self.failure}")
                 return
             self.restore_epoch = latest_complete_checkpoint(self.storage_url, self.job_id)
+            self._event("WARN", "RESTORE",
+                        f"restoring worker set from epoch "
+                        f"{self.restore_epoch or 0} (restart {self.restarts})",
+                        epoch=self.restore_epoch,
+                        data={"restarts": self.restarts})
             self._set_state(JobState.SCHEDULING, restarts=self.restarts,
                             restore_epoch=self.restore_epoch)
 
@@ -177,6 +254,12 @@ class JobController:
             # above survives and triggers a follow-up rescale
             self.db.clear_desired_parallelism(self.job_id, int(target))
         self.restore_epoch = latest_complete_checkpoint(self.storage_url, self.job_id)
+        self._event("WARN", "RESTORE",
+                    f"restoring worker set from epoch "
+                    f"{self.restore_epoch or 0} at parallelism "
+                    f"{self.parallelism} (rescale)",
+                    epoch=self.restore_epoch,
+                    data={"parallelism": self.parallelism})
         self._set_state(JobState.SCHEDULING, restore_epoch=self.restore_epoch,
                         restarts=self.restarts)
 
@@ -261,7 +344,8 @@ class JobController:
         # stale RateTracker points against the old set's (larger) totals
         # would make (new - old)/dt negative for a whole rate window
         self.rates.reset()
-        self.db.update_job(self.job_id, n_workers=len(self.handles))
+        self.db.update_job(self.job_id, n_workers=len(self.handles),
+                           health=self.health.state)
         self.running_since = time.monotonic()
         self.last_checkpoint_time = time.monotonic()
         if self.restore_epoch:
@@ -340,11 +424,8 @@ class JobController:
                 cleanup_checkpoints(self.storage_url, self.job_id, newest_epoch)
                 self.db.record_checkpoint(self.job_id, newest_epoch, "compacted")
             except Exception:  # noqa: BLE001 - GC is best-effort maintenance
-                import logging
-
-                logging.getLogger("arroyo_tpu.controller").exception(
-                    "checkpoint GC failed for %s at epoch %d",
-                    self.job_id, newest_epoch)
+                _log.exception("checkpoint GC failed for %s at epoch %d",
+                               self.job_id, newest_epoch)
 
         self._gc_thread = threading.Thread(
             target=_run_gc, daemon=True, name=f"ckpt-gc-{self.job_id}")
@@ -359,6 +440,7 @@ class JobController:
 
         self._metrics_by_worker[widx] = data
         merged = merge_job_metrics(self._metrics_by_worker.values())
+        self._last_merged_metrics = merged  # the health rules' input
         now = time.monotonic()
         for op, m in merged.items():
             self.rates.observe(
@@ -398,7 +480,8 @@ class JobController:
             self._set_state(JobState.FINISHED)
         return True
 
-    def _on_worker_failed(self, error: str, job: dict) -> None:
+    def _on_worker_failed(self, error: str, job: dict,
+                          worker: Optional[int] = None) -> None:
         """Any worker of the set failing (crash, heartbeat loss, wedged
         checkpoints) takes the WHOLE set down: the survivors hold state the
         failed worker's subtasks fed, so the only consistent restart is the
@@ -406,6 +489,9 @@ class JobController:
         a set dying mid-rescale still rescales, a set dying while stopping
         just stops (Stopping/CheckpointStopping have no Recovering edge)."""
         self.failure = error
+        self._event("ERROR", "WORKER_LOST",
+                    (error or "worker failure").splitlines()[0][:300],
+                    worker=worker)
         self._kill_all()
         self.restarts += 1
         if self.state == JobState.RESCALING:
@@ -444,6 +530,12 @@ class JobController:
             # over the emptied directory (silent state loss on restore); a
             # torn epoch without its marker is invisible anyway
             self.db.record_checkpoint(self.job_id, epoch, "failed")
+            self._event(
+                "WARN", "EPOCH_WEDGED",
+                f"epoch {epoch} exceeded checkpoint.timeout-ms; torn shards "
+                "subsumed, retrying at a fresh epoch",
+                epoch=epoch,
+                data={"unacked": [list(s) for s in outstanding]})
             # attach the epoch's trace timeline: the wedge diagnostic names
             # the exact subtask whose barrier never arrived / never acked,
             # and the persisted trace makes the postmortem queryable
@@ -465,10 +557,8 @@ class JobController:
                     try:
                         subsume_torn_epoch(self.storage_url, self.job_id, e)
                     except Exception:  # noqa: BLE001 - orphans stay invisible
-                        import logging
-
-                        logging.getLogger("arroyo_tpu.controller").exception(
-                            "subsume of torn epoch %d failed for %s", e, self.job_id)
+                        _log.exception("subsume of torn epoch %d failed for %s",
+                                       e, self.job_id)
 
             threading.Thread(target=_subsume, daemon=True,
                              name=f"subsume-{self.job_id}").start()
@@ -528,6 +618,11 @@ class JobController:
                         self.job_id, int(ev["epoch"]), ev["name"],
                         ev.get("node"), ev.get("subtask"), ev.get("worker"),
                         ev.get("t_us"))
+                elif kind == "log":
+                    # a worker subprocess relayed a structured job event
+                    # (OPERATOR_PANIC, COMMIT_REDELIVERED, bridged stdlib
+                    # records, ...); the controller's feed is authoritative
+                    events_recorder.ingest(self.job_id, ev.get("data") or {})
                 elif kind == "checkpoint_completed":
                     if self.coordinator is not None:
                         continue  # coordinated sets: durability is decided HERE
@@ -547,8 +642,13 @@ class JobController:
                     break  # slot emptied; finished is a worker's last event
                 elif kind == "failed":
                     self._on_worker_failed(
-                        ev.get("error", "unknown worker failure"), job)
+                        ev.get("error", "unknown worker failure"), job,
+                        worker=widx)
                     return
+
+        # health monitors: every supervision tick evaluates the rule set
+        # over the latest merged metrics (hysteresis inside the monitor)
+        self._eval_health()
 
         # heartbeat / liveness per worker (reference worker-heartbeat-timeout)
         hb_timeout = cfgv.get("pipeline.worker-heartbeat-timeout-ms") / 1000
@@ -561,7 +661,8 @@ class JobController:
                 time.monotonic() - h.last_heartbeat() > hb_timeout
             ):
                 self._on_worker_failed(
-                    f"worker {widx} lost (heartbeat timeout)", job)
+                    f"worker {widx} lost (heartbeat timeout)", job,
+                    worker=widx)
                 return
 
         # stuck-checkpoint watchdog (checkpoint.timeout-ms)
@@ -591,6 +692,10 @@ class JobController:
             want = job.get("desired_parallelism")
             if want and int(want) != self.parallelism:
                 self.rescale_to = int(want)
+                self._event("INFO", "RESCALE",
+                            f"rescale {self.parallelism} -> {int(want)}: "
+                            "draining the set behind a final checkpoint",
+                            data={"from": self.parallelism, "to": int(want)})
                 self.stopping_epoch = self.next_epoch
                 self.next_epoch += 1
                 self._trigger_checkpoint(self.stopping_epoch, then_stop=True)
@@ -677,6 +782,10 @@ class ControllerServer:
                     self.db.record_trace(
                         jid, epoch, obs_trace.recorder.events(jid, epoch))
                 obs_trace.recorder.clear_job(jid)
+                # job event feed: final flush, then free the ring (the DB
+                # copy is the postmortem surface)
+                jc._flush_events()
+                events_recorder.clear_job(jid)
                 del self.jobs[jid]
                 continue
             jc.step()

@@ -101,6 +101,26 @@ CREATE TABLE IF NOT EXISTS job_profiles (
     data TEXT NOT NULL,           -- JSON compact per-operator cost profile
     updated_at REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS job_events (
+    job_id TEXT NOT NULL,
+    seq INTEGER NOT NULL,         -- controller-side event-log seq (cursor)
+    ts_us INTEGER NOT NULL,
+    level TEXT NOT NULL,          -- DEBUG | INFO | WARN | ERROR
+    code TEXT NOT NULL,           -- stable EventCode (obs.events)
+    node TEXT,                    -- scope: operator node id
+    subtask INTEGER,
+    worker INTEGER,
+    epoch INTEGER,
+    message TEXT NOT NULL,
+    data TEXT,                    -- JSON extra payload
+    PRIMARY KEY (job_id, seq)
+);
+CREATE TABLE IF NOT EXISTS job_health (
+    job_id TEXT PRIMARY KEY,
+    state TEXT NOT NULL,          -- ok | degraded | critical
+    data TEXT NOT NULL,           -- JSON per-rule detail (obs.health)
+    updated_at REAL NOT NULL
+);
 """
 
 _OUTPUT_CAP = 10_000  # preview rows retained per job
@@ -119,6 +139,7 @@ class Database:
             for migration in (
                 "ALTER TABLE jobs ADD COLUMN desired_parallelism INTEGER",
                 "ALTER TABLE jobs ADD COLUMN n_workers INTEGER NOT NULL DEFAULT 1",
+                "ALTER TABLE jobs ADD COLUMN health TEXT",
                 "ALTER TABLE checkpoints ADD COLUMN phases TEXT",
             ):
                 try:
@@ -459,6 +480,96 @@ class Database:
                 "SELECT data FROM job_metrics WHERE job_id=?", (job_id,)
             ).fetchone()
         return json.loads(row["data"]) if row else None
+
+    _EVENTS_CAP = 1000  # newest structured events retained per job
+
+    def record_events(self, job_id: str, events: list[dict]) -> None:
+        """Append structured job events (obs.events dicts carrying the
+        controller-side ``seq``), bounded to the newest _EVENTS_CAP per
+        job. Idempotent per (job, seq): a re-flushed event is skipped
+        rather than duplicated."""
+        if not events:
+            return
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO job_events (job_id, seq, ts_us, level, code, "
+                "node, subtask, worker, epoch, message, data) "
+                "VALUES (?,?,?,?,?,?,?,?,?,?,?) "
+                "ON CONFLICT(job_id, seq) DO NOTHING",
+                [(job_id, int(e["seq"]), int(e["ts_us"]), e["level"],
+                  e["code"], e.get("node"), e.get("subtask"),
+                  e.get("worker"), e.get("epoch"), e.get("message", ""),
+                  json.dumps(e.get("data") or {}))
+                 for e in events],
+            )
+            self._conn.execute(
+                "DELETE FROM job_events WHERE job_id=? AND seq <= ("
+                "SELECT MAX(seq) FROM job_events WHERE job_id=?) - ?",
+                (job_id, job_id, self._EVENTS_CAP),
+            )
+            self._conn.commit()
+
+    def list_events(self, job_id: str, level: Optional[str] = None,
+                    since: Optional[float] = None, after_seq: int = 0,
+                    limit: int = 1000) -> list[dict]:
+        """Structured events oldest first; ``level`` is a minimum (WARN
+        returns WARN+ERROR), ``since`` a unix-seconds floor, ``after_seq``
+        the incremental-tail cursor (`logs --follow` / API ?after=)."""
+        from ..obs.events import level_rank
+
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM job_events WHERE job_id=? AND seq > ? "
+                "ORDER BY seq LIMIT ?",
+                (job_id, int(after_seq), int(limit))).fetchall()
+        out = []
+        floor = level_rank(level) if level is not None else None
+        for r in rows:
+            e = dict(r)
+            e.pop("job_id", None)
+            e["data"] = json.loads(e["data"]) if e["data"] else {}
+            if floor is not None and level_rank(e["level"]) < floor:
+                continue
+            if since is not None and e["ts_us"] < since * 1e6:
+                continue
+            out.append(e)
+        return out
+
+    def last_event_seq(self, job_id: str) -> int:
+        """Max persisted event seq for a job — a restarted controller
+        seeds the in-memory event log past it (obs.events
+        ``ensure_seq_floor``) so post-restart events don't collide with
+        already-persisted (job, seq) rows."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT MAX(seq) AS s FROM job_events WHERE job_id=?",
+                (job_id,)).fetchone()
+        return int(row["s"] or 0)
+
+    def record_health(self, job_id: str, state: str, data: dict) -> None:
+        """Latest per-rule health detail (obs.health.HealthMonitor
+        evaluation) behind GET /api/v1/jobs/<id>/health."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO job_health (job_id, state, data, updated_at) "
+                "VALUES (?,?,?,?) ON CONFLICT(job_id) DO UPDATE SET "
+                "state=excluded.state, data=excluded.data, "
+                "updated_at=excluded.updated_at",
+                (job_id, state, json.dumps(data), time.time()),
+            )
+            self._conn.commit()
+
+    def get_health(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT state, data, updated_at FROM job_health WHERE job_id=?",
+                (job_id,)).fetchone()
+        if row is None:
+            return None
+        out = json.loads(row["data"])
+        out["state"] = row["state"]
+        out["updated_at"] = row["updated_at"]
+        return out
 
     def record_profile(self, job_id: str, data: dict) -> None:
         """Latest compact per-operator cost profile (obs.profile.job_profile

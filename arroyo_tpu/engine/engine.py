@@ -39,6 +39,7 @@ from ..state.tables import (
     latest_complete_checkpoint,
     write_job_checkpoint_metadata,
 )
+from ..obs.events import recorder as events_recorder
 from ..obs.trace import recorder as trace_recorder
 from ..obs.trace import now_us, timeline_report
 from ..types import CheckpointBarrier, ControlMessage, ControlResp, TaskInfo
@@ -166,12 +167,18 @@ class Engine:
         # set by _abort(): distinguishes a torn-down engine from a drained
         # one — an externally-killed worker must not report "finished"
         self._aborted = False
-        # epoch-lifecycle tracing: every engine records its subtasks' span
-        # events into the process-global recorder; a worker subprocess
-        # additionally relays them (relay_spans set by the worker CLI) so
-        # the CONTROLLER's recorder holds the whole job's timeline
-        self.relay_spans = False
+        # obs relay (worker subprocesses only; relay_obs set by the worker
+        # CLI): epoch-lifecycle spans AND structured job events recorded in
+        # this process are forwarded over the JSON-lines protocol so the
+        # CONTROLLER's recorders hold the whole job's timeline + event feed.
+        # All worker->controller streams drain through ONE helper
+        # (drain_relay) so a new event kind never grows a new hand-rolled
+        # drain with its own ordering bugs.
+        self.relay_obs = False
         self.span_events: "_queue.Queue[dict]" = _queue.Queue()
+        # relay cursors: job-event seq and epochs already reported
+        self._relay_event_seq = events_recorder.last_seq(job_id)
+        self._relay_reported_epochs: set[int] = set()
 
     def _span(self, epoch: int, event: str, node: Optional[str] = None,
               subtask: Optional[int] = None, worker: Optional[int] = None,
@@ -179,11 +186,62 @@ class Engine:
         t = now_us() if t_us is None else int(t_us)
         trace_recorder.record(self.job_id, epoch, event, node, subtask,
                               worker, t)
-        if self.relay_spans:
+        if self.relay_obs:
             self.span_events.put({
                 "event": "span", "epoch": epoch, "name": event, "node": node,
                 "subtask": subtask, "worker": worker, "t_us": t,
             })
+
+    def drain_relay(self, include_metrics: bool = False) -> list[dict]:
+        """ONE drain for every worker->controller relay stream, in the
+        order the controller must observe them (the PR 6 drain-ordering bug
+        class, fixed structurally):
+
+          1. epoch-lifecycle span events — must land in the controller's
+             trace recorder BEFORE the coordinator ack that completes
+             global coverage, or the persisted epoch trace misses the
+             final ack span;
+          2. structured job events (obs.events) recorded in this process
+             since the last drain — a task's OPERATOR_PANIC precedes the
+             worker's terminal "failed" event, which the CLI loop emits
+             only after draining;
+          3. the per-second metrics snapshot (caller-throttled: it rides
+             the heartbeat cadence and its chaos drop);
+          4. coordinator acks / completed epochs, strictly last.
+
+        A fourth relayed event kind slots in here — never as a fourth
+        hand-rolled drain in the CLI loop."""
+        out: list[dict] = []
+        while True:
+            try:
+                out.append(self.span_events.get_nowait())
+            except _queue.Empty:
+                break
+        if self.relay_obs:
+            evs = events_recorder.events(self.job_id,
+                                         after_seq=self._relay_event_seq)
+            if evs:
+                self._relay_event_seq = evs[-1]["seq"]
+                out.extend({"event": "log", "data": e} for e in evs)
+        if include_metrics:
+            from ..metrics import registry as _metrics_registry
+
+            out.append({"event": "metrics",
+                        "data": _metrics_registry.job_metrics(self.job_id)})
+        if self.coordinated:
+            while True:
+                try:
+                    out.append(self.coordinator_events.get_nowait())
+                except _queue.Empty:
+                    break
+        else:
+            with self._lock:
+                completed = sorted(
+                    self._completed_epochs - self._relay_reported_epochs)
+            for ep in completed:
+                self._relay_reported_epochs.add(ep)
+                out.append({"event": "checkpoint_completed", "epoch": ep})
+        return out
 
     # -------------------------------------------------------------- building
 
@@ -469,6 +527,13 @@ class Engine:
         for e in delivered:
             if e != epoch:
                 self._span(e, "commit_delivered", worker=self.worker_index)
+                # a lost phase-2 commit recovered by cumulative delivery is
+                # an operational fact worth a feed entry, not just a span
+                events_recorder.record(
+                    self.job_id, "WARN", "COMMIT_REDELIVERED",
+                    message=f"phase-2 commit for epoch {e} re-delivered "
+                            f"cumulatively with epoch {epoch}",
+                    worker=self.worker_index, epoch=e)
         self._span(epoch, "commit_delivered", worker=self.worker_index)
 
     def heartbeat(self) -> float:

@@ -115,6 +115,9 @@ class Task:
         # liveness beat: updated every run-loop iteration / control poll /
         # backpressure wait; a hung task stops beating (Engine.heartbeat)
         self.last_progress = time.monotonic()
+        # epoch being snapshotted right now (None otherwise): an exception
+        # mid-checkpoint stamps its OPERATOR_PANIC event with the epoch
+        self._ckpt_epoch: Optional[int] = None
         # True when the run loop drained cleanly (graceful EOF or
         # checkpoint-then-stop): only such finishes carry final/durable
         # state and may stand in for epoch coverage (ControlResp.clean)
@@ -197,7 +200,25 @@ class Task:
                 self._run_operator()
             self._resp("task_finished", clean=self.finished_clean)
         except Exception:
-            self._resp("task_failed", error=traceback.format_exc())
+            tb = traceback.format_exc()
+            # structured event BEFORE the failure propagates: the job event
+            # feed names the operator/subtask (+ epoch when the panic hit
+            # mid-checkpoint) with a stable traceback digest, so a crashed
+            # pipeline is diagnosable from `logs` without stderr archaeology
+            from ..obs.events import recorder as _events
+            from ..obs.events import traceback_digest
+
+            dig = traceback_digest(tb)
+            _events.record(
+                self.task_info.job_id, "ERROR", "OPERATOR_PANIC",
+                message=dig["error"] or "operator raised",
+                node=self.task_info.node_id,
+                subtask=self.task_info.subtask_index,
+                epoch=self._ckpt_epoch,
+                data={"digest": dig["digest"],
+                      "operator": self.task_info.operator_name},
+            )
+            self._resp("task_failed", error=tb)
 
     def _run_source(self) -> None:
         op: SourceOperator = self.operator  # type: ignore[assignment]
@@ -242,6 +263,7 @@ class Task:
         self._resp("checkpoint_event", checkpoint_event=CheckpointEvent(
             barrier.epoch, self.task_info.node_id, self.task_info.subtask_index,
             int(time.time() * 1e6), "started_checkpointing"))
+        self._ckpt_epoch = barrier.epoch
         prof = self.profiler
         t0 = prof.begin() if prof is not None else None
         if prof is not None:
@@ -260,6 +282,7 @@ class Task:
                     node=self.task_info.node_id,
                     subtask=self.task_info.subtask_index)
         self.collector.broadcast(Signal.barrier_of(barrier))
+        self._ckpt_epoch = None
         self._resp("checkpoint_completed", epoch=barrier.epoch, subtask_metadata=meta)
 
     def _run_operator(self) -> None:
@@ -304,6 +327,7 @@ class Task:
             self._resp("checkpoint_event", checkpoint_event=CheckpointEvent(
                 b.epoch, self.task_info.node_id, self.task_info.subtask_index,
                 int(time.time() * 1e6), "started_checkpointing"))
+            self._ckpt_epoch = b.epoch
             t0 = prof.begin() if prof is not None else None
             op.handle_checkpoint(b, self.ctx, self.collector)
             if prof is not None:
@@ -320,6 +344,7 @@ class Task:
                         node=self.task_info.node_id,
                         subtask=self.task_info.subtask_index)
             self.collector.broadcast(Signal.barrier_of(b))
+            self._ckpt_epoch = None
             self._resp("checkpoint_completed", epoch=b.epoch, subtask_metadata=meta)
 
         def try_complete_alignment():

@@ -184,6 +184,13 @@ class MetricsRegistry:
         # whoever declares an epoch durable (engine single-worker, the
         # controller's coordinator otherwise) from the epoch trace
         self._phases: dict[tuple[str, str], Histogram] = {}
+        # job_id -> ok|degraded|critical, set by the controller's health
+        # monitors each supervision tick (obs/health.py)
+        self._job_health: dict[str, str] = {}
+
+    def set_job_health(self, job_id: str, state: str) -> None:
+        with self._lock:
+            self._job_health[job_id] = state
 
     def task(self, job_id: str, node_id: str, subtask: int) -> TaskMetrics:
         key = (job_id, node_id, subtask)
@@ -221,6 +228,7 @@ class MetricsRegistry:
             self._phases = {
                 k: v for k, v in self._phases.items() if k[0] != job_id
             }
+            self._job_health.pop(job_id, None)
 
     def prometheus_text(self) -> str:
         """Prometheus exposition format (served at /metrics)."""
@@ -307,11 +315,32 @@ class MetricsRegistry:
                 emit_histogram(name, label, h)
         with self._lock:
             phase_hists = sorted(self._phases.items())
+            job_health = sorted(self._job_health.items())
         if phase_hists:
             lines.append("# TYPE arroyo_checkpoint_phase_seconds histogram")
             for (job, phase), h in phase_hists:
                 emit_histogram("arroyo_checkpoint_phase_seconds",
                                f'job="{job}",phase="{phase}"', h)
+        # health state per job (0 ok / 1 degraded / 2 critical) and the
+        # structured-event counters (obs/events.py rings keep the newest
+        # events; these counts keep the totals)
+        if job_health:
+            from .obs.health import health_value
+
+            lines.append("# TYPE arroyo_job_health gauge")
+            for job, state in job_health:
+                lines.append(
+                    f'arroyo_job_health{{job="{job}",state="{state}"}} '
+                    f"{health_value(state)}")
+        from .obs.events import recorder as _events_recorder
+
+        counts = _events_recorder.counts_snapshot()
+        if counts:
+            lines.append("# TYPE arroyo_events_total counter")
+            for (job, code, level), n in sorted(counts.items()):
+                lines.append(
+                    f'arroyo_events_total{{job="{job}",code="{code}",'
+                    f'level="{level}"}} {n}')
         return "\n".join(lines) + "\n"
 
     def job_metrics(self, job_id: str) -> dict:

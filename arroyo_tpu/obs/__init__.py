@@ -10,6 +10,14 @@ self-time, state-size gauges, key-skew sketches via ``obs.sketch``, the
 controller-DB-backed per-operator table behind ``python -m arroyo_tpu
 top``. The watermark-lag gauge, sink end-to-end latency, and checkpoint
 phase histograms live in ``arroyo_tpu.metrics`` next to the task counters.
+
+``obs.events`` is the third pillar: the structured per-job event log
+(operator panics, restores, wedged epochs, commit re-deliveries, rescales,
+health transitions) behind ``GET /api/v1/jobs/<id>/events`` and
+``python -m arroyo_tpu logs``; ``obs.health`` holds the controller-side
+health monitors (rule set + hysteresis over the merged job metrics) whose
+state surfaces as ``arroyo_job_health``, the jobs API ``health`` field,
+and ``GET /api/v1/jobs/<id>/health``.
 """
 
 from .trace import (  # noqa: F401 - public API

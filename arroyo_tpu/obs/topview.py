@@ -51,6 +51,7 @@ def render(job: dict, metrics: Optional[dict],
            checkpoints: Optional[list[dict]] = None) -> str:
     """One refresh frame of the live job view (plain text, one table)."""
     head = (f"job {job['id']}  state={job['state']}  "
+            f"health={job.get('health') or 'ok'}  "
             f"workers={job.get('n_workers', 1)}  "
             f"restarts={job.get('restarts', 0)}  "
             f"epoch={job.get('checkpoint_epoch', 0)}")

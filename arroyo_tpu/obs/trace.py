@@ -171,7 +171,8 @@ def dominant_phase(phases: dict[str, float]) -> Optional[str]:
     return max(phases, key=lambda k: phases[k])
 
 
-def chrome_trace(job_id: str, events_by_epoch: dict[int, list[dict]]) -> dict:
+def chrome_trace(job_id: str, events_by_epoch: dict[int, list[dict]],
+                 job_events: Optional[list[dict]] = None) -> dict:
     """Chrome trace-event JSON for one job's recorded epochs.
 
     Spans render one track per subtask (tid = "node/subtask") inside one
@@ -181,7 +182,13 @@ def chrome_trace(job_id: str, events_by_epoch: dict[int, list[dict]]) -> dict:
     "commit" span (metadata_durable -> last commit event). A phase still
     open when the trace was taken (a wedged subtask) is emitted as a "B"
     begin-event with no matching end — trace viewers render it running to
-    the end of the timeline, which is exactly the visual for "stuck"."""
+    the end of the timeline, which is exactly the visual for "stuck".
+
+    ``job_events`` (structured obs.events dicts): entries scoped to a
+    rendered epoch are added as instant markers — an OPERATOR_PANIC or
+    EPOCH_WEDGED lands on its subtask's (or the job's "events") track at
+    the exact wall time, so one Perfetto view correlates the span tree
+    with the event feed."""
     out: list[dict] = []
 
     def span(name: str, tid: str, t0: Optional[int], t1: Optional[int],
@@ -216,6 +223,20 @@ def chrome_trace(job_id: str, events_by_epoch: dict[int, list[dict]]) -> dict:
                 out.append({"name": "ack", "cat": "checkpoint", "ph": "i",
                             "pid": job_id, "tid": tid, "ts": ack, "s": "t",
                             "args": {"epoch": epoch}})
+    rendered = set(events_by_epoch)
+    for ev in job_events or ():
+        if ev.get("epoch") is None or int(ev["epoch"]) not in rendered:
+            continue
+        tid = (f"{ev['node']}/{ev['subtask']}"
+               if ev.get("node") is not None and ev.get("subtask") is not None
+               else "events")
+        out.append({
+            "name": ev.get("code", "EVENT"), "cat": "events", "ph": "i",
+            "pid": job_id, "tid": tid, "ts": int(ev["ts_us"]), "s": "p",
+            "args": {"epoch": int(ev["epoch"]),
+                     "level": ev.get("level"),
+                     "message": ev.get("message", "")},
+        })
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 

@@ -20,14 +20,35 @@ from typing import Optional
 _START_TIME = time.time()
 
 
+# event-scope attributes a record may carry (via ``extra=``; the JobEvent
+# bridge reads the same names) — emitted by BOTH structured formatters
+_EVENT_FIELDS = ("job_id", "node", "subtask", "worker", "epoch")
+
+
+def _record_fields(formatter: logging.Formatter,
+                   record: logging.LogRecord) -> dict:
+    """The shared field set both structured formatters render, in order.
+    One extraction point means the json and logfmt views of a record can
+    never disagree on names or values (unit-tested for parity)."""
+    out = {
+        "ts": formatter.formatTime(record, "%Y-%m-%dT%H:%M:%S"),
+        "level": record.levelname,
+        "target": record.name,
+        "message": record.getMessage(),
+    }
+    code = getattr(record, "event_code", None)
+    if code is not None:
+        out["code"] = str(code)
+    for field in _EVENT_FIELDS:
+        v = getattr(record, field, None)
+        if v is not None:
+            out[field] = v
+    return out
+
+
 class _JsonFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
-        out = {
-            "ts": self.formatTime(record, "%Y-%m-%dT%H:%M:%S"),
-            "level": record.levelname,
-            "target": record.name,
-            "message": record.getMessage(),
-        }
+        out = _record_fields(self, record)
         if record.exc_info:
             out["exception"] = self.formatException(record.exc_info)
         return json.dumps(out, separators=(",", ":"))
@@ -35,20 +56,35 @@ class _JsonFormatter(logging.Formatter):
 
 class _LogfmtFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
-        msg = record.getMessage().replace('"', '\\"')
-        return (
-            f'ts={self.formatTime(record, "%Y-%m-%dT%H:%M:%S")} '
-            f'level={record.levelname.lower()} target={record.name} '
-            f'msg="{msg}"'
-        )
+        parts = []
+        for k, v in _record_fields(self, record).items():
+            if k == "level":
+                v = str(v).lower()
+            v = str(v)
+            # '=' and '\' also force quoting (`msg=retries=3` would parse
+            # ambiguously), and newlines must never split a record across
+            # physical lines; backslashes escape before quotes do
+            if v == "" or any(c in v for c in ' "=\\\n\r'):
+                v = ('"' + v.replace("\\", "\\\\").replace('"', '\\"')
+                     .replace("\n", "\\n").replace("\r", "\\r") + '"')
+            parts.append(f"{'msg' if k == 'message' else k}={v}")
+        return " ".join(parts)
 
 
-def init_logging(fmt: Optional[str] = None, level: Optional[str] = None) -> None:
-    """fmt: console | json | logfmt (config [logging] section analog)."""
+def init_logging(fmt: Optional[str] = None, level: Optional[str] = None,
+                 capture_events: Optional[bool] = None) -> None:
+    """fmt: console | json | logfmt (config [logging] section analog).
+
+    ``logging.capture-events`` (or capture_events=True) additionally
+    installs the JobEvent bridge handler: stdlib records carrying job
+    context (``extra={"job_id": ...}``) land in the structured job event
+    feed (obs/events.py) next to the engine's own events."""
     from .config import config
 
     fmt = fmt or config().get("logging.format", "console")
     level = level or config().get("logging.level", "INFO")
+    if capture_events is None:
+        capture_events = bool(config().get("logging.capture-events"))
     root = logging.getLogger()
     root.setLevel(getattr(logging, str(level).upper(), logging.INFO))
     for h in list(root.handlers):
@@ -63,6 +99,10 @@ def init_logging(fmt: Optional[str] = None, level: Optional[str] = None) -> None
             "%(asctime)s %(levelname)-7s %(name)s: %(message)s", "%H:%M:%S"
         ))
     root.addHandler(handler)
+    if capture_events:
+        from .obs.events import install_bridge
+
+        install_bridge(root)
 
 
 class AdminServer:

@@ -485,6 +485,39 @@ def test_lr109_adhoc_self_timing():
     assert "LR109" not in ids_of(lint_source(waived, "arroyo_tpu/operators/x.py"))
 
 
+def test_lr110_logger_in_function():
+    bad = (
+        "import logging\n"
+        "def handle(self):\n"
+        "    logging.getLogger('arroyo_tpu.x').warning('boom')\n"
+    )
+    # per-call named-logger acquisition anywhere in the package
+    assert "LR110" in ids_of(lint_source(bad, "arroyo_tpu/controller/x.py"))
+    assert "LR110" in ids_of(lint_source(bad, "arroyo_tpu/engine/x.py"))
+    # module-level acquisition is the convention — never flagged
+    good = (
+        "import logging\n"
+        "_log = logging.getLogger('arroyo_tpu.x')\n"
+        "def handle(self):\n"
+        "    _log.warning('boom')\n"
+    )
+    assert "LR110" not in ids_of(lint_source(good, "arroyo_tpu/controller/x.py"))
+    # the bare root logger (logging-INIT code reconfiguring handlers) is exempt
+    root = (
+        "import logging\n"
+        "def init():\n"
+        "    logging.getLogger().setLevel(logging.INFO)\n"
+    )
+    assert "LR110" not in ids_of(lint_source(root, "arroyo_tpu/server_common.py"))
+    # outside the package (tools, tests) the rule does not apply
+    assert "LR110" not in ids_of(lint_source(bad, "tools/x.py"))
+    waived = bad.replace(
+        "logging.getLogger('arroyo_tpu.x').warning('boom')",
+        "logging.getLogger('arroyo_tpu.x').warning('boom')"
+        "  # lint: waive LR110 — dynamic per-job logger name")
+    assert "LR110" not in ids_of(lint_source(waived, "arroyo_tpu/controller/x.py"))
+
+
 def test_waivers():
     bad = (
         "def f():\n"

@@ -1,0 +1,659 @@
+"""The third observability pillar: structured job events + health monitors.
+
+Covers obs/events.py (bounded per-job ring, level/seq/since filters,
+stdlib-logging bridge, rendering) and obs/health.py (rule set with
+hysteresis) at unit level, then end-to-end: an operator exception becomes
+an OPERATOR_PANIC event with the right scope; a worker crash mid-checkpoint
+on a 2-worker set leaves a causally ordered ERROR -> RESTORE trail readable
+from the controller DB, the API, and the `logs` CLI, with the same epoch's
+events rendered as instants in the Chrome trace export; a dropped phase-2
+commit proves the worker->controller {"event": "log"} relay over the real
+process-scheduler wire protocol; and a sustained watermark-lag breach
+drives ok -> degraded visibly in `top`, `/health`, and `arroyo_job_health`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import time
+import urllib.request
+
+import pytest
+
+from arroyo_tpu.obs import events as obs_events
+from arroyo_tpu.obs import health as obs_health
+from arroyo_tpu.obs.events import recorder
+
+SMOKE = os.path.join(os.path.dirname(__file__), "smoke")
+
+
+def _sql(tmp_path, name="grouped_aggregates"):
+    with open(os.path.join(SMOKE, "queries", f"{name}.sql")) as f:
+        sql = f.read()
+    out = str(tmp_path / "out.json")
+    return sql.replace("$input_dir", os.path.join(SMOKE, "inputs")).replace(
+        "$output_path", out), out
+
+
+def _assert_golden(out, name="grouped_aggregates"):
+    import glob
+
+    got = []
+    for p in sorted(glob.glob(out) + glob.glob(out + ".*")):
+        with open(p) as f:
+            got.extend(json.loads(l) for l in f if l.strip())
+    with open(os.path.join(SMOKE, "golden", f"{name}.json")) as f:
+        want = [json.loads(l) for l in f if l.strip()]
+    key = lambda r: json.dumps(r, sort_keys=True)
+    assert sorted(map(key, got)) == sorted(map(key, want))
+
+
+# ------------------------------------------------------------ ring, unit
+
+
+def test_event_ring_bounds_under_flood_but_counts_stay_exact():
+    from arroyo_tpu import config as cfg
+
+    job = "flood-job"
+    recorder.clear_job(job)
+    cfg.update({"obs.events.max-per-job": 64})
+    try:
+        for i in range(500):
+            recorder.record(job, "INFO", "LOG", message=f"m{i}")
+        ring = recorder.events(job)
+        # the ring is bounded and keeps the NEWEST events, seq-ordered
+        assert len(ring) == 64
+        assert [e["seq"] for e in ring] == list(range(437, 501))
+        assert ring[-1]["message"] == "m499"
+        # totals survive eviction (the arroyo_events_total surface)
+        assert recorder.counts_snapshot()[(job, "LOG", "INFO")] == 500
+        assert recorder.last_seq(job) == 500
+    finally:
+        cfg.update({"obs.events.max-per-job": 512})
+        recorder.clear_job(job)
+    assert recorder.events(job) == []
+    assert all(k[0] != job for k in recorder.counts_snapshot())
+
+
+def test_event_filters_render_and_trail():
+    job = "filter-job"
+    recorder.clear_job(job)
+    try:
+        recorder.record(job, "DEBUG", "LOG", message="noise")
+        recorder.record(job, "ERROR", "OPERATOR_PANIC", message="div by zero",
+                        node="agg", subtask=1, epoch=3,
+                        data={"digest": "abc123def456"})
+        recorder.record(job, "WARN", "RESTORE", message="restoring", epoch=2,
+                        worker=0)
+        # level is a minimum: WARN returns WARN + ERROR, seq order kept
+        warn_up = recorder.events(job, level="WARN")
+        assert [e["code"] for e in warn_up] == ["OPERATOR_PANIC", "RESTORE"]
+        # seq cursor (the logs --follow / ?after= contract)
+        assert [e["code"] for e in recorder.events(job, after_seq=2)] \
+            == ["RESTORE"]
+        # unknown levels normalize instead of corrupting the ring
+        ev = recorder.record(job, "fatal?!", "LOG")
+        assert ev["level"] == "INFO"
+        # one rendered CLI line carries level, code, scope, message, data
+        line = obs_events.render_event(warn_up[0])
+        assert "ERROR" in line and "OPERATOR_PANIC" in line
+        assert "agg/1" in line and "e3" in line and "div by zero" in line
+        assert "abc123def456" in line
+        # the causal projection chaos tests assert against
+        assert obs_events.trail(warn_up) == ["OPERATOR_PANIC", "RESTORE"]
+    finally:
+        recorder.clear_job(job)
+
+
+def test_ingest_preserves_relayed_identity():
+    """The controller replays worker-relayed events through ingest():
+    original timestamp/level/code/scope survive; seq is reassigned
+    locally; junk is rejected rather than recorded."""
+    job = "ingest-job"
+    recorder.clear_job(job)
+    try:
+        ev = recorder.ingest(job, {
+            "seq": 777, "ts_us": 123_000_000, "level": "WARN",
+            "code": "COMMIT_REDELIVERED", "worker": 1, "epoch": 4,
+            "message": "late commit"})
+        assert ev["ts_us"] == 123_000_000 and ev["level"] == "WARN"
+        assert ev["worker"] == 1 and ev["epoch"] == 4
+        assert ev["seq"] == 1  # local seq, not the relayed one
+        assert recorder.ingest(job, {"event": "not-a-job-event"}) is None
+        assert recorder.ingest(job, "garbage") is None
+        assert len(recorder.events(job)) == 1
+    finally:
+        recorder.clear_job(job)
+
+
+def test_restarted_controller_resumes_past_persisted_seqs(tmp_path):
+    """A controller restart empties the in-memory ring (seq restarts at 1)
+    while the DB keeps rows keyed (job, seq); re-adoption must seed the
+    ring's seq past the persisted max or every new event would collide
+    with an old row and be dropped by the idempotent flush."""
+    from arroyo_tpu.controller import Database
+
+    job = "seq-floor-job"
+    db = Database(str(tmp_path / "ctl.db"))
+    recorder.clear_job(job)
+    try:
+        for i in range(3):
+            recorder.record(job, "INFO", "LOG", message=f"before {i}")
+        db.record_events(job, recorder.events(job))
+        assert db.last_event_seq(job) == 3
+        # "restart": the ring is gone, the DB is not
+        recorder.clear_job(job)
+        recorder.ensure_seq_floor(job, db.last_event_seq(job))
+        ev = recorder.record(job, "WARN", "RESTORE", message="after restart")
+        assert ev["seq"] == 4  # no collision with the persisted rows
+        db.record_events(job, [ev])
+        assert [e["message"] for e in db.list_events(job)] \
+            == ["before 0", "before 1", "before 2", "after restart"]
+        # re-flushing the same seq stays idempotent (skip, not duplicate)
+        db.record_events(job, [ev])
+        assert db.last_event_seq(job) == 4
+        assert len(db.list_events(job)) == 4
+    finally:
+        recorder.clear_job(job)
+
+
+def test_logs_cli_errors_on_unknown_job(tmp_path, capsys):
+    from arroyo_tpu import cli
+    from arroyo_tpu.controller import Database
+
+    db_path = str(tmp_path / "ctl.db")
+    Database(db_path)
+    assert cli.main(["logs", "no-such-job", "--db", db_path]) == 1
+    assert "no such job" in capsys.readouterr().err
+    # --follow must not tail a typo forever
+    assert cli.main(["logs", "no-such-job", "--db", db_path,
+                     "--follow"]) == 1
+
+
+def test_traceback_digest_stable_and_compact():
+    tb = ("Traceback (most recent call last):\n"
+          "  File \"x.py\", line 1, in f\n"
+          "ZeroDivisionError: division by zero\n")
+    d1, d2 = obs_events.traceback_digest(tb), obs_events.traceback_digest(tb)
+    assert d1 == d2  # repeated panics of the same bug aggregate
+    assert d1["error"] == "ZeroDivisionError: division by zero"
+    assert len(d1["digest"]) == 12
+    assert obs_events.traceback_digest(tb + "  extra frame\n") != d1
+
+
+# ------------------------------------------------------- logging bridge
+
+
+def test_logging_bridge_captures_job_scoped_records_only():
+    job = "bridge-job"
+    recorder.clear_job(job)
+    log = logging.getLogger("arroyo_tpu.test_bridge")
+    log.setLevel(logging.INFO)
+    log.propagate = False
+    handler = obs_events.JobEventBridgeHandler()
+    log.addHandler(handler)
+    try:
+        log.warning("spill started", extra={"job_id": job, "node": "agg",
+                                            "subtask": 2})
+        log.error("custom", extra={"job_id": job, "event_code": "RESCALE"})
+        log.info("service-level line with no job context")  # not captured
+        evs = recorder.events(job)
+        assert len(evs) == 2
+        assert evs[0]["code"] == "LOG" and evs[0]["level"] == "WARN"
+        assert evs[0]["node"] == "agg" and evs[0]["subtask"] == 2
+        assert evs[0]["message"] == "spill started"
+        assert evs[1]["code"] == "RESCALE" and evs[1]["level"] == "ERROR"
+    finally:
+        log.removeHandler(handler)
+        recorder.clear_job(job)
+
+
+def test_init_logging_capture_events_installs_bridge_idempotently():
+    from arroyo_tpu.server_common import init_logging
+
+    root = logging.getLogger()
+    saved = list(root.handlers)
+    job = "capture-job"
+    recorder.clear_job(job)
+    try:
+        init_logging(fmt="console", capture_events=True)
+        bridges = [h for h in root.handlers
+                   if isinstance(h, obs_events.JobEventBridgeHandler)]
+        assert len(bridges) == 1
+        # re-init does not stack a second bridge
+        assert obs_events.install_bridge(root) is bridges[0]
+        logging.getLogger("arroyo_tpu.capture").warning(
+            "wedged?", extra={"job_id": job, "epoch": 9})
+        evs = recorder.events(job)
+        assert len(evs) == 1 and evs[0]["epoch"] == 9
+    finally:
+        root.handlers[:] = saved
+        recorder.clear_job(job)
+
+
+def _parse_logfmt(line: str) -> dict:
+    out = {}
+    for m in re.finditer(r'(\w+)=("(?:[^"\\]|\\.)*"|\S+)', line):
+        v = m.group(2)
+        if v.startswith('"'):
+            v = v[1:-1].replace('\\"', '"')
+        out[m.group(1)] = v
+    return out
+
+
+def test_json_and_logfmt_formatters_share_one_field_set():
+    """One record carrying event code + scope renders through BOTH
+    structured formatters with identical names and values (modulo logfmt's
+    lowercase level and msg= spelling) — the shared `_record_fields`
+    extraction means the two formats cannot drift."""
+    from arroyo_tpu.server_common import _JsonFormatter, _LogfmtFormatter
+
+    record = logging.LogRecord("arroyo_tpu.controller", logging.WARNING,
+                               "x.py", 1, "epoch %d wedged", (7,), None)
+    record.job_id = "j-1"
+    record.event_code = "EPOCH_WEDGED"
+    record.node = "agg"
+    record.subtask = 0
+    record.epoch = 7
+    as_json = json.loads(_JsonFormatter().format(record))
+    as_logfmt = _parse_logfmt(_LogfmtFormatter().format(record))
+    assert as_json["code"] == "EPOCH_WEDGED"
+    assert as_json["message"] == "epoch 7 wedged"
+    # logfmt spells message as msg= and lowercases the level; every other
+    # shared field must match the json rendering exactly
+    assert as_logfmt["msg"] == as_json["message"]
+    assert as_logfmt["level"] == as_json["level"].lower() == "warning"
+    for field in ("ts", "target", "code", "job_id", "node", "subtask",
+                  "epoch"):
+        assert str(as_json[field]) == as_logfmt[field], field
+    # a message containing '=' (but no space) must be quoted, or logfmt
+    # parsers would read `msg=retries=3` as a bogus extra key
+    eq = logging.LogRecord("t", logging.INFO, "x.py", 1, "retries=3",
+                           (), None)
+    assert 'msg="retries=3"' in _LogfmtFormatter().format(eq)
+    # newlines must never split one record across physical lines
+    nl = logging.LogRecord("t", logging.INFO, "x.py", 1, "bad\nthing",
+                           (), None)
+    line = _LogfmtFormatter().format(nl)
+    assert "\n" not in line and 'msg="bad\\nthing"' in line
+
+
+# ------------------------------------------------------ health, unit
+
+
+def _snap(**per_op):
+    return {op: vals for op, vals in per_op.items()}
+
+
+def test_health_hysteresis_does_not_flap_on_oscillation():
+    from arroyo_tpu import config as cfg
+
+    cfg.update({"health.fire-ticks": 3, "health.clear-ticks": 2})
+    transitions = []
+    try:
+        mon = obs_health.HealthMonitor(
+            "h-job", on_transition=lambda o, n, d: transitions.append((o, n)))
+        # a metric oscillating around the threshold every tick never fires
+        for i in range(20):
+            bp = 0.95 if i % 2 == 0 else 0.5  # threshold 0.9
+            mon.evaluate(_snap(agg={"backpressure": bp}))
+        assert mon.state == "ok" and transitions == []
+        # three consecutive breaching ticks fire the rule — exactly once
+        for _ in range(3):
+            detail = mon.evaluate(_snap(agg={"backpressure": 0.95}))
+        assert mon.state == "degraded"
+        assert transitions == [("ok", "degraded")]
+        assert mon.firing_rules() == ["backpressure"]
+        rule = next(r for r in detail["rules"] if r["rule"] == "backpressure")
+        assert rule["firing"] and rule["value"] == 0.95
+        assert rule["threshold"] == pytest.approx(0.9)
+        # one healthy tick does NOT clear (clear-ticks=2)…
+        mon.evaluate(_snap(agg={"backpressure": 0.1}))
+        assert mon.state == "degraded"
+        # …and a breach in between restarts the healthy count
+        mon.evaluate(_snap(agg={"backpressure": 0.95}))
+        mon.evaluate(_snap(agg={"backpressure": 0.1}))
+        assert mon.state == "degraded"
+        mon.evaluate(_snap(agg={"backpressure": 0.1}))
+        assert mon.state == "ok"
+        assert transitions == [("ok", "degraded"), ("degraded", "ok")]
+    finally:
+        cfg.update({"health.fire-ticks": 3, "health.clear-ticks": 5})
+
+
+def test_health_checkpoint_streak_is_critical_and_absent_metrics_are_healthy():
+    from arroyo_tpu import config as cfg
+
+    cfg.update({"health.fire-ticks": 2, "health.clear-ticks": 2})
+    try:
+        mon = obs_health.HealthMonitor("h-crit")
+        # missing metrics (pre-first-batch) evaluate healthy, not unknown
+        assert mon.evaluate(None)["state"] == "ok"
+        assert mon.evaluate(_snap(agg={"backpressure": None}))["state"] == "ok"
+        for _ in range(2):
+            detail = mon.evaluate(None, ckpt_failures=3)
+        assert mon.state == "critical"
+        assert detail["state"] == "critical"
+        # worst firing severity wins: degraded rule + critical rule
+        mon2 = obs_health.HealthMonitor("h-mix")
+        for _ in range(2):
+            d = mon2.evaluate(_snap(agg={"watermark_lag_seconds": 1e6}),
+                              ckpt_failures=5)
+        assert d["state"] == "critical"
+        firing = {r["rule"] for r in d["rules"] if r["firing"]}
+        assert firing == {"watermark-lag", "checkpoint-failures"}
+    finally:
+        cfg.update({"health.fire-ticks": 3, "health.clear-ticks": 5})
+    assert obs_health.health_value("ok") == 0
+    assert obs_health.health_value("critical") == 2
+    assert obs_health.health_event_code("degraded") == "HEALTH_DEGRADED"
+
+
+# --------------------------------------- operator panic, engine level
+
+
+def test_operator_exception_becomes_scoped_panic_event(tmp_path, _storage):
+    """A task raising in the run loop records OPERATOR_PANIC — naming the
+    node/subtask, the epoch (the injected crash fires mid-checkpoint), and
+    a stable traceback digest — BEFORE the failure propagates."""
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu import faults
+    from arroyo_tpu.batch import TIMESTAMP_FIELD, Schema
+    from arroyo_tpu.engine.engine import Engine
+    from arroyo_tpu.expr import Col
+    from arroyo_tpu.graph import EdgeType, Graph, Node, OpName
+
+    src = tmp_path / "in.json"
+    with open(src, "w") as f:
+        for i in range(500):
+            f.write(json.dumps({"x": i, "_timestamp": i * 1000}) + "\n")
+    S = Schema.of([("x", "int64"), (TIMESTAMP_FIELD, "int64")])
+    rows: list = []
+    g = Graph()
+    g.add_node(Node("src", OpName.SOURCE, {
+        "connector": "single_file", "path": str(src), "schema": S}, 1))
+    g.add_node(Node("wm", OpName.WATERMARK, {
+        "expr": Col(TIMESTAMP_FIELD), "interval_micros": 1000}, 1))
+    g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": rows}, 1))
+    g.add_edge("src", "wm", EdgeType.FORWARD, S)
+    g.add_edge("wm", "sink", EdgeType.FORWARD, S)
+
+    job = "panic-scope"
+    recorder.clear_job(job)
+    cfg.update({"testing.source-read-delay-micros": 2000})
+    faults.install("worker:crash@barrier=1&step=1", seed=5)
+    eng = Engine(g, job_id=job)
+    try:
+        eng.start()
+        eng.trigger_checkpoint(1)
+        with pytest.raises(RuntimeError):
+            eng.join(timeout=60)
+    finally:
+        faults.clear()
+        cfg.update({"testing.source-read-delay-micros": 0})
+        eng.stop()
+
+    panics = [e for e in recorder.events(job) if e["code"] == "OPERATOR_PANIC"]
+    assert panics, recorder.events(job)
+    ev = panics[0]
+    assert ev["level"] == "ERROR"
+    assert ev["node"] is not None and ev["subtask"] is not None
+    assert ev["epoch"] == 1  # the crash fired mid-checkpoint
+    assert re.fullmatch(r"[0-9a-f]{12}", ev["data"]["digest"])
+    assert "InjectedCrash" in ev["message"]
+    recorder.clear_job(job)
+
+
+# ------------------------------------------- chaos trail, end to end
+
+
+@pytest.mark.chaos
+def test_chaos_crash_leaves_causal_event_trail(tmp_path, _storage, capsys):
+    """Acceptance: a worker crash mid-checkpoint on a 2-worker set yields —
+    via the controller DB, GET /jobs/<id>/events, and `arroyo_tpu logs` —
+    a causally ordered ERROR (OPERATOR_PANIC/WORKER_LOST) -> WARN RESTORE
+    trail naming the epoch/worker/subtask; the same epoch's events appear
+    as instant markers in the Chrome trace export; goldens stay byte-exact."""
+    from arroyo_tpu import cli
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu import faults
+    from arroyo_tpu.api import ApiServer
+    from arroyo_tpu.controller import ControllerServer, Database
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+
+    sql, out = _sql(tmp_path)
+    db_path = str(tmp_path / "ctl.db")
+    db = Database(db_path)
+    cfg.update({
+        "controller.workers-per-job": 2,
+        "checkpoint.interval-ms": 150,
+        # generous runway: the crash installs only after the first complete
+        # epoch, and the next periodic barrier must still beat EOF (this
+        # box throttles hard — a short run can finish before the fault)
+        "testing.source-read-delay-micros": 10000,
+    })
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+    api = ApiServer(db, port=0).start()
+    try:
+        pid = db.create_pipeline("agg", sql, 2)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        # let one epoch complete first, so the crash restores from a real
+        # checkpoint (a deterministic, non-None restore epoch in the trail)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not any(
+                c["state"] == "complete" for c in db.list_checkpoints(jid)):
+            time.sleep(0.05)
+        assert any(c["state"] == "complete" for c in db.list_checkpoints(jid))
+        # crash one subtask at its NEXT barrier, mid-checkpoint
+        inj = faults.install("worker:crash@step=1", seed=23)
+        state = ctl.wait_for_state(jid, "Finished", timeout=180)
+        assert state == "Finished"
+        assert int(db.get_job(jid)["restarts"]) >= 1
+        assert inj.fired_log, "crash fault never fired"
+
+        # --- the trail, from the persisted DB table -------------------
+        evs = db.list_events(jid)
+        trail = obs_events.trail(evs)
+        assert "RESTORE" in trail, trail
+        errors = [e for e in evs if e["level"] == "ERROR"]
+        assert errors, trail
+        # causal order: the crash ERROR strictly precedes the RESTORE
+        first_restore = trail.index("RESTORE")
+        first_error = next(i for i, e in enumerate(evs)
+                           if e["level"] == "ERROR")
+        assert first_error < first_restore, trail
+        # scope: the panic names its node/subtask + mid-checkpoint epoch,
+        # the loss names the worker, the restore names the restore epoch
+        panic = next(e for e in evs if e["code"] == "OPERATOR_PANIC")
+        assert panic["node"] is not None and panic["subtask"] is not None
+        assert panic["epoch"] is not None  # the crash fired mid-checkpoint
+        lost = next(e for e in evs if e["code"] == "WORKER_LOST")
+        assert lost["worker"] is not None
+        restore = next(e for e in evs if e["code"] == "RESTORE")
+        # the crashed epoch never went durable: the set restored from an
+        # earlier, globally complete one
+        assert restore["epoch"] is not None
+        assert restore["epoch"] < panic["epoch"]
+        assert restore["data"]["restarts"] >= 1
+
+        # --- the same trail over the API, with level filtering --------
+        base = f"http://127.0.0.1:{api.port}"
+        with urllib.request.urlopen(
+                f"{base}/api/v1/jobs/{jid}/events?level=ERROR",
+                timeout=10) as r:
+            api_errors = json.loads(r.read())["data"]
+        assert api_errors and all(e["level"] == "ERROR" for e in api_errors)
+        assert {e["code"] for e in api_errors} \
+            <= {"OPERATOR_PANIC", "WORKER_LOST"}
+
+        # --- the logs CLI renders it (DB and API paths) ---------------
+        assert cli.main(["logs", jid, "--db", db_path]) == 0
+        text = capsys.readouterr().out
+        assert "OPERATOR_PANIC" in text and "RESTORE" in text
+        assert cli.main(["logs", jid, "--api", base, "--level", "ERROR"]) == 0
+        text = capsys.readouterr().out
+        assert "WORKER_LOST" in text and "RESTORE" not in text
+
+        # --- epoch-scoped events appear as trace instants -------------
+        with urllib.request.urlopen(
+                f"{base}/api/v1/jobs/{jid}/traces", timeout=10) as r:
+            chrome = json.loads(r.read())
+        instants = [e for e in chrome["traceEvents"] if e["cat"] == "events"]
+        assert any(e["name"] == "OPERATOR_PANIC" for e in instants), instants
+        panic_i = next(e for e in instants if e["name"] == "OPERATOR_PANIC")
+        assert panic_i["ph"] == "i"
+        assert panic_i["args"]["epoch"] == panic["epoch"]
+        assert panic_i["tid"] == f"{panic['node']}/{panic['subtask']}"
+    finally:
+        faults.clear()
+        cfg.update({"controller.workers-per-job": 1,
+                    "checkpoint.interval-ms": 10_000,
+                    "testing.source-read-delay-micros": 0})
+        ctl.stop()
+        api.stop()
+    _assert_golden(out)
+
+
+@pytest.mark.chaos
+def test_process_worker_relays_events_over_wire(tmp_path, _storage, capsys):
+    """Worker->controller relay on the REAL wire protocol: subprocess
+    workers of a 2-worker process-scheduler set record COMMIT_REDELIVERED
+    in their own process (the controller drops phase-2 commits for epoch 1;
+    cumulative delivery recovers them at epoch 2) and relay the events as
+    {"event": "log"} JSON lines; the controller ingests, persists, and
+    serves them through the API and the logs CLI."""
+    from arroyo_tpu import cli
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu import faults
+    from arroyo_tpu.api import ApiServer
+    from arroyo_tpu.controller import ControllerServer, Database
+    from arroyo_tpu.controller.scheduler import ProcessScheduler
+
+    sql, out = _sql(tmp_path)
+    db_path = str(tmp_path / "ctl.db")
+    db = Database(db_path)
+    os.environ["ARROYO_TPU__TESTING__SOURCE_READ_DELAY_MICROS"] = "8000"
+    os.environ["ARROYO_TPU__CHECKPOINT__STORAGE_URL"] = cfg.config().get(
+        "checkpoint.storage-url")
+    cfg.update({"controller.workers-per-job": 2,
+                "checkpoint.interval-ms": 300})
+    # the drop fires in THIS (controller) process at commit fan-out; the
+    # workers' cumulative re-delivery is what generates the relayed events
+    inj = faults.install("commit:drop@epoch=1", seed=11)
+    ctl = ControllerServer(db, ProcessScheduler()).start()
+    api = ApiServer(db, port=0).start()
+    try:
+        pid = db.create_pipeline("agg", sql, 2)
+        jid = db.create_job(pid)
+        state = ctl.wait_for_state(jid, "Finished", timeout=180)
+        assert state == "Finished"
+        assert inj.fired_log, "commit drop never fired"
+
+        evs = db.list_events(jid)
+        redelivered = [e for e in evs if e["code"] == "COMMIT_REDELIVERED"]
+        assert redelivered, [e["code"] for e in evs]
+        # the event crossed the wire carrying its worker-side scope
+        assert all(e["epoch"] == 1 for e in redelivered)
+        workers = {e["worker"] for e in redelivered}
+        assert workers and workers <= {0, 1}
+        assert all(e["level"] == "WARN" for e in redelivered)
+
+        base = f"http://127.0.0.1:{api.port}"
+        with urllib.request.urlopen(
+                f"{base}/api/v1/jobs/{jid}/events?level=WARN",
+                timeout=10) as r:
+            api_evs = json.loads(r.read())["data"]
+        assert any(e["code"] == "COMMIT_REDELIVERED" for e in api_evs)
+
+        assert cli.main(["logs", jid, "--api", base]) == 0
+        assert "COMMIT_REDELIVERED" in capsys.readouterr().out
+        _assert_golden(out)
+    finally:
+        os.environ.pop("ARROYO_TPU__TESTING__SOURCE_READ_DELAY_MICROS", None)
+        os.environ.pop("ARROYO_TPU__CHECKPOINT__STORAGE_URL", None)
+        faults.clear()
+        cfg.update({"controller.workers-per-job": 1,
+                    "checkpoint.interval-ms": 10_000})
+        ctl.stop()
+        api.stop()
+
+
+# ------------------------------------------- health, end to end
+
+
+def test_sustained_breach_degrades_job_visibly(tmp_path, _storage, capsys):
+    """Acceptance: a job whose watermark lag sustainedly breaches its
+    (deliberately tiny) ceiling transitions ok -> degraded within the
+    configured fire-ticks — visible in the jobs API `health` field, the
+    per-rule /health endpoint, the HEALTH_DEGRADED event, the
+    arroyo_job_health gauge, and the `top` header line."""
+    from arroyo_tpu import cli
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.api import ApiServer
+    from arroyo_tpu.controller import ControllerServer, Database
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.metrics import registry
+
+    sql, out = _sql(tmp_path)
+    db_path = str(tmp_path / "ctl.db")
+    db = Database(db_path)
+    cfg.update({
+        "checkpoint.interval-ms": 10_000,
+        "testing.source-read-delay-micros": 15000,
+        # input timestamps are micros-from-zero, so observed lag is ~the
+        # wall clock: any positive ceiling is a sustained breach
+        "health.watermark-lag-max-s": 0.001,
+        "health.fire-ticks": 2,
+    })
+    ctl = ControllerServer(db, EmbeddedScheduler()).start()
+    api = ApiServer(db, port=0).start()
+    try:
+        # parallelism 1: the single-reader source feeds every subtask, so
+        # the sink observes watermarks (and therefore lag) mid-run
+        pid = db.create_pipeline("agg", sql, 1)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            job = db.get_job(jid)
+            if (job.get("health") == "degraded"
+                    or job["state"] != "Running"):
+                break
+            time.sleep(0.05)
+        assert db.get_job(jid)["health"] == "degraded"
+
+        # per-rule detail names the breaching rule with observed/threshold
+        base = f"http://127.0.0.1:{api.port}"
+        with urllib.request.urlopen(f"{base}/api/v1/jobs/{jid}/health",
+                                    timeout=10) as r:
+            detail = json.loads(r.read())
+        assert detail["state"] == "degraded"
+        lag = next(r for r in detail["rules"] if r["rule"] == "watermark-lag")
+        assert lag["firing"] and lag["value"] > lag["threshold"]
+
+        # the transition emitted exactly one HEALTH_DEGRADED event
+        degraded = [e for e in db.list_events(jid)
+                    if e["code"] == "HEALTH_DEGRADED"]
+        assert len(degraded) == 1 and degraded[0]["level"] == "WARN"
+        assert any(f["rule"] == "watermark-lag"
+                   for f in degraded[0]["data"]["firing"])
+
+        # exposition gauge + the top header line
+        text = registry.prometheus_text()
+        assert (f'arroyo_job_health{{job="{jid}",state="degraded"}} 1'
+                in text), text
+        assert cli.main(["top", jid, "--db", db_path, "--once"]) == 0
+        assert "health=degraded" in capsys.readouterr().out
+
+        ctl.wait_for_state(jid, "Finished", timeout=120)
+    finally:
+        cfg.update({"checkpoint.interval-ms": 10_000,
+                    "testing.source-read-delay-micros": 0,
+                    "health.watermark-lag-max-s": 900.0,
+                    "health.fire-ticks": 3})
+        ctl.stop()
+        api.stop()

@@ -10,6 +10,11 @@
 #                              assert every metric name emitted in code
 #                              appears in the README "Observability"
 #                              catalog (grep-based; keeps docs honest)
+#   tools/lint.sh --events-catalog
+#                              assert every EventCode the package can emit
+#                              (obs/events.py EVENT_CODES, cross-checked
+#                              against code-site literals) is documented in
+#                              the README "Events & health" table
 #
 # Exit non-zero on any unwaived lint finding or unexpected check result.
 set -euo pipefail
@@ -29,7 +34,9 @@ import glob, re, sys
 # job-level facts, not worker-loop counters — so they match explicitly
 NAME_RE = re.compile(r"arroyo_(?:worker|checkpoint)_[a-z0-9_]+"
                      r"|arroyo_state_(?:rows|bytes)"
-                     r"|arroyo_late_rows_total")
+                     r"|arroyo_late_rows_total"
+                     r"|arroyo_job_health"
+                     r"|arroyo_events_total")
 code_names: set[str] = set()
 for p in glob.glob("arroyo_tpu/**/*.py", recursive=True):
     with open(p) as f:
@@ -44,6 +51,55 @@ if missing:
         print(f"  {m}")
     sys.exit(1)
 print(f"metrics-catalog: ok ({len(code_names)} metric names documented)")
+EOF
+fi
+
+if [[ "${1:-}" == "--events-catalog" ]]; then
+    python - <<'EOF'
+import ast, glob, re, sys
+
+from arroyo_tpu.obs.events import EVENT_CODES, LEVELS
+
+# every string literal used as an event code at a recorder.record()/
+# JobController._event() call site must be declared in EVENT_CODES, and
+# every declared code must be documented in the README "Events & health"
+# table (AST-walked so formatting can't hide a call site)
+CODE_RE = re.compile(r"^[A-Z][A-Z_]+$")
+EVENT_CALLS = ("record", "_event")
+code_sites: set[str] = set()
+for p in glob.glob("arroyo_tpu/**/*.py", recursive=True):
+    with open(p) as f:
+        tree = ast.parse(f.read(), p)
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in EVENT_CALLS):
+            continue
+        recv = n.func.value
+        recv_name = getattr(recv, "id", getattr(recv, "attr", ""))
+        if n.func.attr == "record" and "event" not in recv_name.lower() \
+                and recv_name != "recorder":
+            continue  # trace/metric .record() calls are out of scope
+        for a in n.args:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+                    and CODE_RE.match(a.value) and a.value not in LEVELS:
+                code_sites.add(a.value)
+undeclared = sorted(c for c in code_sites if c not in EVENT_CODES)
+if undeclared:
+    print("events-catalog: emitted codes missing from obs.events.EVENT_CODES:")
+    for c in undeclared:
+        print(f"  {c}")
+    sys.exit(1)
+with open("README.md") as f:
+    readme = f.read()
+missing = sorted(c for c in EVENT_CODES if f"`{c}`" not in readme)
+if missing:
+    print("events-catalog: EventCodes missing from the README "
+          "'Events & health' table:")
+    for c in missing:
+        print(f"  {c}")
+    sys.exit(1)
+print(f"events-catalog: ok ({len(EVENT_CODES)} event codes documented, "
+      f"{len(code_sites)} emitted in code)")
 EOF
 fi
 
