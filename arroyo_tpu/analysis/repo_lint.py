@@ -525,7 +525,8 @@ _DECLARED_FAULT_SITES = (
     "storage.put", "storage.get", "storage.delete", "storage.list",
     "storage.multipart", "network.send", "network.recv", "queue.put",
     "connector.poll", "connector.commit", "worker", "worker.heartbeat",
-    "node.start_worker", "controller_rpc", "commit",
+    "node.start_worker", "controller_rpc", "commit", "rescale",
+    "autoscale_decide",
 )
 
 

@@ -141,7 +141,8 @@ class ArroyoClient:
 
     def job_health(self, job_id: str) -> dict:
         """Job health (ok/degraded/critical) with per-rule observed value,
-        threshold, and firing flag."""
+        threshold, and firing flag, plus the elastic autoscaler's rail
+        state and last decision under the ``autoscaler`` key."""
         return self._req("GET", f"/api/v1/jobs/{job_id}/health")
 
     def list_connectors(self) -> dict:

@@ -77,6 +77,34 @@ JOB_HEALTH = {
                 "breaching": {"type": "boolean"},
                 "firing": {"type": "boolean"},
             }}},
+        # elastic-autoscaler readout (controller/autoscaler.py): rail
+        # state, live signals, and the last decision
+        "autoscaler": {
+            "type": "object",
+            "properties": {
+                "enabled": {"type": "boolean"},
+                "parallelism": {"type": "integer"},
+                "target": {"type": "integer"},
+                "in_flight": {"type": "boolean"},
+                "up_ticks": {"type": "integer"},
+                "down_ticks": {"type": "integer"},
+                "cooldown_remaining_s": {"type": "number"},
+                "backoff_remaining_s": {"type": "number"},
+                "failures": {"type": "integer"},
+                "signals": {"type": "array", "items": {
+                    "type": "object",
+                    "properties": {
+                        "signal": _STR, "value": {"type": "number"},
+                        "threshold": {"type": "number"},
+                        # pressure rows carry `breaching` (true = bad);
+                        # the headroom row carries `proven` (true = idle
+                        # enough to scale down) — opposite polarity
+                        "breaching": {"type": "boolean"},
+                        "proven": {"type": "boolean"},
+                    }}},
+                "last_decision": {"type": "object"},
+            },
+        },
     },
 }
 UDF = {
@@ -200,7 +228,9 @@ def spec() -> dict:
             "/api/v1/jobs/{job_id}/health": {
                 "get": _op("job_health", "job health state with per-rule "
                            "detail (hysteresis-filtered monitors over the "
-                           "merged job metrics)", ["job_id"],
+                           "merged job metrics) plus the elastic "
+                           "autoscaler's rail state and last decision",
+                           ["job_id"],
                            response=JOB_HEALTH)},
             "/api/v1/connectors": {
                 "get": _op("list_connectors", "available connectors")},

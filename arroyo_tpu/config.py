@@ -124,6 +124,37 @@ _DEFAULTS: dict[str, Any] = {
         "sink-latency-p99-max-s": 600.0,
         "checkpoint-failure-streak": 2,
     },
+    "autoscaler": {
+        # elastic autoscaler (controller/autoscaler.py): closes the loop
+        # from the health sensors to worker count through the coordinated
+        # checkpoint/drain/restore rescale path. Off by default — turning
+        # it on hands the parallelism knob to the control loop.
+        "enabled": False,
+        "min-parallelism": 1,
+        "max-parallelism": 8,
+        # hysteresis: consecutive pressured ticks before a scale-up /
+        # consecutive proven-headroom ticks before a scale-down
+        "up-ticks": 3,
+        "down-ticks": 10,
+        # step sizing: up multiplies (ceil), down halves (floor), always
+        # at least one step and always clamped to the bounds above
+        "up-factor": 2.0,
+        "down-factor": 0.5,
+        # scale-up pressure thresholds over the merged metrics snapshot
+        "up-backpressure": 0.8,
+        "up-queue-transit-p99-ms": 750.0,
+        "up-watermark-lag-s": 30.0,
+        "up-sink-latency-p99-s": 30.0,
+        # scale-down headroom ceilings (worst-subtask busy%, backpressure)
+        "down-busy-max-pct": 25.0,
+        "down-backpressure-max": 0.1,
+        # cooldown after any worker-set (re)start; exponential backoff
+        # after a disrupted scale transition
+        "cooldown-s": 30.0,
+        "backoff-base-s": 10.0,
+        "backoff-multiplier": 2.0,
+        "backoff-max-s": 300.0,
+    },
     "obs": {
         # structured job event log (obs/events.py): bounded per-job ring
         "events": {"max-per-job": 512},

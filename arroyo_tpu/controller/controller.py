@@ -29,6 +29,7 @@ from ..obs import trace as obs_trace
 from ..obs.events import recorder as events_recorder
 from ..obs.health import HealthMonitor, health_event_code
 from ..state.tables import latest_complete_checkpoint
+from .autoscaler import Autoscaler
 from .db import Database
 from .scheduler import Scheduler, WorkerHandle, scheduler_for
 from .states import JobState, check_transition
@@ -86,6 +87,14 @@ class JobController:
         # merged metrics snapshot; transitions emit HEALTH_* events
         self.health = HealthMonitor(job_id,
                                     on_transition=self._on_health_transition)
+        # the actuator on top of those sensors (controller/autoscaler.py):
+        # decides a target parallelism on the same tick and actuates it
+        # through the normal desired_parallelism -> Rescaling drain path
+        self.autoscaler = Autoscaler(job_id, emit=self._event)
+        # the target of the autoscale currently actuating (None when no
+        # autoscale is pending): AUTOSCALE_DONE fires only when a worker
+        # set actually starts at exactly this parallelism
+        self._autoscale_target: Optional[int] = None
         self._last_merged_metrics: Optional[dict] = None
         self._last_health_persist = 0.0
         # job event log: incremental flush cursor into the job_events table.
@@ -129,16 +138,31 @@ class JobController:
         self._event(level, code, f"health {old} -> {new} ({names})",
                     data={"firing": firing})
         self.db.update_job(self.job_id, health=new)
+        detail = {**detail,
+                  "autoscaler": self.autoscaler.detail(self.parallelism)}
         self.db.record_health(self.job_id, new, detail)
 
     def _eval_health(self) -> None:
-        if not config().get("health.enabled", True):
-            return
-        detail = self.health.evaluate(self._last_merged_metrics,
-                                      ckpt_failures=self._ckpt_failures)
         from ..metrics import registry as metrics_registry
 
-        metrics_registry.set_job_health(self.job_id, self.health.state)
+        health_on = bool(config().get("health.enabled", True))
+        autoscale_on = self.autoscaler.enabled()
+        if not health_on and not autoscale_on:
+            return
+        if health_on:
+            detail = self.health.evaluate(self._last_merged_metrics,
+                                          ckpt_failures=self._ckpt_failures)
+            metrics_registry.set_job_health(self.job_id, self.health.state)
+        else:
+            # monitors off, autoscaler on: the /health payload still has
+            # to carry the autoscaler readout (and the gauge must export)
+            detail = {"state": self.health.state, "rules": []}
+        # the /health payload doubles as the autoscaler's readout: rail
+        # state, live signals, and the last decision ride every persist
+        detail["autoscaler"] = self.autoscaler.detail(self.parallelism)
+        if autoscale_on:
+            metrics_registry.set_autoscaler_target(
+                self.job_id, self.autoscaler.target(self.parallelism))
         # transitions persist immediately (_on_health_transition); between
         # them, refresh the per-rule observed values at ~1 Hz for /health
         now = time.monotonic()
@@ -247,6 +271,13 @@ class JobController:
         fresh = self.db.get_job(self.job_id) or job
         target = fresh.get("desired_parallelism") or self.rescale_to
         self.rescale_to = None
+        if self._autoscale_target is not None and (
+                not target or int(target) != self._autoscale_target):
+            # the drain completed toward a DIFFERENT parallelism — a newer
+            # manual target superseded the autoscale (or the request was
+            # cleared) — so no AUTOSCALE_DONE may fire for this restart,
+            # nor for any later unrelated one
+            self._autoscale_target = None
         if target:
             self.parallelism = int(target)
             self.db.set_pipeline_parallelism(job["pipeline_id"], int(target))
@@ -341,6 +372,10 @@ class JobController:
         self._inflight_epochs = {}
         self._ckpt_failures = 0
         self._metrics_by_worker = {}
+        # the old set's final merged snapshot is stale the moment the new
+        # set exists: health/autoscaler must not act on its (typically
+        # terrible) last readings until a fresh report lands
+        self._last_merged_metrics = None
         # stale RateTracker points against the old set's (larger) totals
         # would make (new - old)/dt negative for a whole rate window
         self.rates.reset()
@@ -351,6 +386,24 @@ class JobController:
         if self.restore_epoch:
             self.next_epoch = self.restore_epoch + 1
         self._set_state(JobState.RUNNING)
+        # DONE only when this (re)start actually landed the decided
+        # target — a crash restore racing in between the decision and
+        # the rescale pickup restarts at the OLD parallelism first (the
+        # still-pending desired_parallelism completes the scale on a
+        # later pass through here), and a transition superseded by a
+        # newer manual target cleared the flag in _finish_rescale
+        if self._autoscale_target is not None \
+                and self.parallelism == self._autoscale_target:
+            self._autoscale_target = None
+            self._event("INFO", "AUTOSCALE_DONE",
+                        f"worker set running at parallelism "
+                        f"{self.parallelism} (autoscale)",
+                        data={"parallelism": self.parallelism,
+                              "restore_epoch": self.restore_epoch})
+        # any (re)start arms the autoscaler cooldown: post-restart metrics
+        # are warm-up noise whether a rescale, a crash restore, or a fresh
+        # schedule caused it (this also clears an in-flight autoscale)
+        self.autoscaler.on_worker_set_started()
 
     # ------------------------------------------------- worker-set control
 
@@ -362,9 +415,23 @@ class JobController:
             self.coordinator.begin(epoch)
         obs_trace.recorder.record(self.job_id, epoch, "trigger")
         self._inflight_epochs[epoch] = time.monotonic()
-        for h in self.handles:
-            if h is not None:
-                h.trigger_checkpoint(epoch, then_stop=then_stop)
+        rescaling = then_stop and (self.rescale_to is not None
+                                   or self.state == JobState.RESCALING)
+        from ..faults import fault_point
+
+        for widx, h in enumerate(self.handles):
+            if h is None:
+                continue
+            if rescaling:
+                # chaos site `rescale`: the scale command to one worker
+                # can be lost or delayed mid-transition. Recovery is
+                # protocol-level: the unreached worker never acks, the
+                # stuck-epoch watchdog declares the drain epoch failed
+                # and re-triggers it at a fresh epoch (then_stop intact)
+                verdict = fault_point("rescale", epoch=epoch, worker=widx)
+                if verdict is not None and verdict[0] == "drop":
+                    continue
+            h.trigger_checkpoint(epoch, then_stop=then_stop)
 
     def _epoch_durable(self, epoch: int) -> None:
         """An epoch's job-level metadata marker is durable (written by the
@@ -496,7 +563,10 @@ class JobController:
         self.restarts += 1
         if self.state == JobState.RESCALING:
             # drain failed mid-rescale: still proceed to the new
-            # parallelism from whatever checkpoint exists
+            # parallelism from whatever checkpoint exists — but an
+            # autoscaler-initiated transition that got disrupted arms the
+            # exponential backoff before its NEXT decision
+            self.autoscaler.on_scale_disrupted(error or "worker failure")
             self._finish_rescale(job)
         elif self.state in (JobState.STOPPING, JobState.CHECKPOINT_STOPPING):
             self._set_state(JobState.STOPPED)
@@ -684,6 +754,33 @@ class JobController:
             for h in self.handles:
                 if h is not None:
                     h.stop()
+
+        # elastic autoscaler: sustained pressure (or proven headroom) on
+        # the merged metrics becomes a desired_parallelism the rescale
+        # block below actuates through the normal drain/restore path. A
+        # manual request already in flight always wins — the loop never
+        # fights the operator — and a non-Running tick only resets the
+        # hysteresis counters.
+        can_scale = (self.state == JobState.RUNNING and not desired_stop
+                     and not job.get("desired_parallelism"))
+        target = self.autoscaler.evaluate(
+            self._last_merged_metrics if can_scale else None,
+            running=can_scale, parallelism=self.parallelism,
+            ckpt_failures=self._ckpt_failures)
+        if target is not None:
+            # compare-and-set: a manual PATCH landing between this tick's
+            # job-row read and here must win, not be clobbered
+            if not self.db.set_desired_parallelism_if_unset(
+                    self.job_id, target):
+                self.autoscaler.abandon_in_flight()
+            else:
+                self._autoscale_target = target
+                self._event("INFO", "AUTOSCALE_STARTED",
+                            f"autoscale {self.parallelism} -> {target}: "
+                            "draining the set behind a final checkpoint",
+                            data={"from": self.parallelism, "to": target})
+                job = dict(job)
+                job["desired_parallelism"] = target  # same-tick pickup below
 
         # rescale requests from the API (reference states/rescaling.rs:1-70):
         # checkpoint-and-stop the old worker set, then reschedule at the new

@@ -217,6 +217,19 @@ class Database:
                 ).fetchall()
         return [dict(r) for r in rows]
 
+    def set_desired_parallelism_if_unset(self, jid: str, target: int) -> bool:
+        """Compare-and-set for the autoscaler's actuation: the write lands
+        only while no rescale request is pending, so a manual PATCH racing
+        in between the controller's job-row read and this write is never
+        clobbered (manual requests always win). Returns True iff set."""
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE jobs SET desired_parallelism=?, updated_at=? "
+                "WHERE id=? AND desired_parallelism IS NULL",
+                (int(target), time.time(), jid))
+            self._conn.commit()
+            return cur.rowcount > 0
+
     def clear_desired_parallelism(self, jid: str, expected: int) -> None:
         """Clear the rescale request iff it still holds the value we just
         applied; a newer concurrent request survives to trigger again."""

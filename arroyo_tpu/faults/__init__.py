@@ -49,6 +49,14 @@ Instrumented sites:
     commit              phase-2 commit fan-out of the controller's 2PC
                         (ctx: epoch, worker); drop proves a lost commit is
                         re-delivered with the next epoch, not lost
+    rescale             the per-worker scale command of a live rescale
+                        (the then_stop drain trigger; ctx: epoch, worker):
+                        drop/delay it mid-transition — the stuck-epoch
+                        watchdog must re-trigger the drain, never wedge
+    autoscale_decide    the autoscaler's decision point (ctx: key=job,
+                        target, direction): force=N substitutes a bogus
+                        target the min/max rails must clamp, drop
+                        suppresses the decision, fail costs one tick
 """
 
 from __future__ import annotations
@@ -77,7 +85,8 @@ SITES = (
     "storage.put", "storage.get", "storage.delete", "storage.list",
     "storage.multipart", "network.send", "network.recv", "queue.put",
     "connector.poll", "connector.commit", "worker", "worker.heartbeat",
-    "node.start_worker", "controller_rpc", "commit",
+    "node.start_worker", "controller_rpc", "commit", "rescale",
+    "autoscale_decide",
 )
 
 

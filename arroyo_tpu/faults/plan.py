@@ -27,6 +27,9 @@ Sites are dotted names named by the instrumented call sites (see
     delay=MS    sleep MS milliseconds at the call site, then continue
     hang=S      sleep S seconds (models a stall; pairs with heartbeat
                 timeouts), then continue
+    force=V     tell the call site to substitute the value V for whatever
+                it was about to use (site-specific: e.g. autoscale_decide
+                forces a bogus target parallelism the rails must clamp)
 
 Conditions restrict when a spec matches. ``match=SUBSTR`` tests substring
 containment against the call's ``key`` context (paths, shard ids, quads);
@@ -55,10 +58,10 @@ from typing import Optional
 _log = logging.getLogger("arroyo_tpu.faults")
 
 # actions that raise at the fault point; everything else returns a verdict
-# the call site applies itself (drop/dup) or that the injector applies
-# inline (delay/hang)
+# the call site applies itself (drop/dup/force) or that the injector
+# applies inline (delay/hang)
 _RAISING = ("fail", "fail_once", "fail_n", "crash", "partition")
-_KNOWN_ACTIONS = _RAISING + ("drop", "dup", "delay", "hang")
+_KNOWN_ACTIONS = _RAISING + ("drop", "dup", "delay", "hang", "force")
 
 
 class InjectedFault(RuntimeError):
@@ -131,7 +134,7 @@ def parse_plan(plan: str) -> list[FaultSpec]:
             raise PlanSyntaxError(
                 f"fault spec {raw!r}: unknown action {action!r} "
                 f"(have: {', '.join(_KNOWN_ACTIONS)})")
-        if action in ("fail_n", "delay", "hang") and arg is None:
+        if action in ("fail_n", "delay", "hang", "force") and arg is None:
             raise PlanSyntaxError(f"fault spec {raw!r}: {action} needs =ARG")
         conds: dict = {}
         if cond_str:

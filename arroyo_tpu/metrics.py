@@ -187,10 +187,18 @@ class MetricsRegistry:
         # job_id -> ok|degraded|critical, set by the controller's health
         # monitors each supervision tick (obs/health.py)
         self._job_health: dict[str, str] = {}
+        # job_id -> target parallelism, set by the controller's elastic
+        # autoscaler (controller/autoscaler.py) when enabled: the in-flight
+        # target while a scale actuates, else the current parallelism
+        self._autoscaler_target: dict[str, int] = {}
 
     def set_job_health(self, job_id: str, state: str) -> None:
         with self._lock:
             self._job_health[job_id] = state
+
+    def set_autoscaler_target(self, job_id: str, target: int) -> None:
+        with self._lock:
+            self._autoscaler_target[job_id] = int(target)
 
     def task(self, job_id: str, node_id: str, subtask: int) -> TaskMetrics:
         key = (job_id, node_id, subtask)
@@ -229,6 +237,7 @@ class MetricsRegistry:
                 k: v for k, v in self._phases.items() if k[0] != job_id
             }
             self._job_health.pop(job_id, None)
+            self._autoscaler_target.pop(job_id, None)
 
     def prometheus_text(self) -> str:
         """Prometheus exposition format (served at /metrics)."""
@@ -316,6 +325,7 @@ class MetricsRegistry:
         with self._lock:
             phase_hists = sorted(self._phases.items())
             job_health = sorted(self._job_health.items())
+            autoscaler_targets = sorted(self._autoscaler_target.items())
         if phase_hists:
             lines.append("# TYPE arroyo_checkpoint_phase_seconds histogram")
             for (job, phase), h in phase_hists:
@@ -332,6 +342,11 @@ class MetricsRegistry:
                 lines.append(
                     f'arroyo_job_health{{job="{job}",state="{state}"}} '
                     f"{health_value(state)}")
+        if autoscaler_targets:
+            lines.append("# TYPE arroyo_autoscaler_target gauge")
+            for job, target in autoscaler_targets:
+                lines.append(
+                    f'arroyo_autoscaler_target{{job="{job}"}} {target}')
         from .obs.events import recorder as _events_recorder
 
         counts = _events_recorder.counts_snapshot()

@@ -55,6 +55,20 @@ EVENT_CODES: dict[str, tuple[str, str]] = {
     "RESCALE": (
         "INFO", "a live rescale started (data: from/to parallelism); the "
                 "set drains behind a final checkpoint and restarts"),
+    "AUTOSCALE_DECISION": (
+        "INFO", "the elastic autoscaler decided a target parallelism after "
+                "its hysteresis window (data: direction, from/to, raw "
+                "target before the min/max rails, breaching signals)"),
+    "AUTOSCALE_STARTED": (
+        "INFO", "an autoscaler-initiated rescale began actuating: the set "
+                "drains behind a final checkpoint (data: from/to)"),
+    "AUTOSCALE_DONE": (
+        "INFO", "the autoscaled worker set is running at its new "
+                "parallelism (data: parallelism, restore epoch)"),
+    "AUTOSCALE_BACKOFF": (
+        "WARN", "a scale transition was disrupted; the next decision is "
+                "gated by an exponential backoff window (data: backoff_s, "
+                "consecutive failures)"),
     "HEALTH_DEGRADED": (
         "WARN", "a health rule fired past its hysteresis window; the job "
                 "is degraded (data: per-rule detail)"),

@@ -1,0 +1,533 @@
+"""Elastic autoscaler: the control loop's rails, and the chaos axis.
+
+Unit tests drive ``controller.autoscaler.Autoscaler`` with a FAKE clock
+and hand-fed metrics snapshots — no wall-time sleeps, because this box's
+CPU throttling makes real-time hysteresis assertions flaky. Only the
+end-to-end chaos tests (scale-up mid-stream, scale-down with state
+repartitioning, worker crash during the scale transition, controller
+restart mid-rescale) touch real time, and they assert byte-exact golden
+output plus the AUTOSCALE_* event trail rather than durations.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+import pytest
+
+from arroyo_tpu.controller import ControllerServer, Database
+from arroyo_tpu.controller.autoscaler import Autoscaler
+from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+
+SMOKE = os.path.join(os.path.dirname(__file__), "smoke")
+
+HOT = {"agg": {"backpressure": 0.95, "busy_pct": 90.0}}
+IDLE = {"agg": {"backpressure": 0.0, "busy_pct": 5.0}}
+
+
+def _sql(tmp_path, name="grouped_aggregates"):
+    with open(os.path.join(SMOKE, "queries", f"{name}.sql")) as f:
+        sql = f.read()
+    out = str(tmp_path / "out.json")
+    return sql.replace("$input_dir", os.path.join(SMOKE, "inputs")).replace(
+        "$output_path", out
+    ), out
+
+
+def _assert_golden(out, name="grouped_aggregates"):
+    got = []
+    for p in sorted(glob.glob(out) + glob.glob(out + ".*")):
+        with open(p) as f:
+            got.extend(json.loads(l) for l in f if l.strip())
+    with open(os.path.join(SMOKE, "golden", f"{name}.json")) as f:
+        want = [json.loads(l) for l in f if l.strip()]
+    key = lambda r: json.dumps(r, sort_keys=True)
+    assert sorted(map(key, got)) == sorted(map(key, want))
+
+
+def _loop(**over):
+    """A fake-clock Autoscaler plus its captured events and the clock."""
+    from arroyo_tpu import config as cfg
+
+    cfg.update({"autoscaler.enabled": True, "autoscaler.up-ticks": 3,
+                "autoscaler.down-ticks": 4, "autoscaler.cooldown-s": 30.0,
+                "autoscaler.backoff-base-s": 10.0,
+                "autoscaler.max-parallelism": 4,
+                **{f"autoscaler.{k.replace('_', '-')}": v
+                   for k, v in over.items()}})
+    clock = [1000.0]
+    events: list[tuple] = []
+    a = Autoscaler(
+        "j1", emit=lambda lvl, code, msg, **kw: events.append(
+            (lvl, code, kw.get("data"))),
+        clock=lambda: clock[0])
+    return a, events, clock
+
+
+# ------------------------------------------------------ unit: the rails
+
+
+def test_scale_up_hysteresis_and_cooldown():
+    a, events, clock = _loop()
+    a.on_worker_set_started()  # fresh set arms the cooldown
+    # sustained pressure during cooldown decides nothing...
+    for _ in range(6):
+        assert a.evaluate(HOT, running=True, parallelism=2) is None
+    # ...but the armed streak fires on the first post-cooldown tick
+    clock[0] += 31
+    assert a.evaluate(HOT, running=True, parallelism=2) == 4
+    assert a.in_flight == 4
+    assert [c for _l, c, _d in events] == ["AUTOSCALE_DECISION"]
+    assert events[0][2]["signals"] == ["backpressure"]
+    # in-flight gates further decisions until the set restarts
+    assert a.evaluate(HOT, running=True, parallelism=2) is None
+    a.on_worker_set_started()
+    assert a.in_flight is None
+    # one breached tick is not hysteresis
+    clock[0] += 31
+    assert a.evaluate(HOT, running=True, parallelism=4) is None
+    assert a.evaluate(IDLE, running=True, parallelism=4) is None  # resets
+    for _ in range(2):
+        assert a.evaluate(HOT, running=True, parallelism=4) is None
+
+
+def test_scale_down_only_on_proven_headroom():
+    a, events, clock = _loop(down_ticks=3, cooldown_s=0.0)
+    # absent busy%/backpressure proves nothing: no scale-down, ever
+    for _ in range(10):
+        assert a.evaluate({"agg": {"backpressure": 0.0}},
+                          running=True, parallelism=4) is None
+    # empty snapshot proves nothing either
+    for _ in range(10):
+        assert a.evaluate(None, running=True, parallelism=4) is None
+    # proven headroom: three consecutive ticks, then down 4 -> 2
+    assert a.evaluate(IDLE, running=True, parallelism=4) is None
+    assert a.evaluate(IDLE, running=True, parallelism=4) is None
+    assert a.evaluate(IDLE, running=True, parallelism=4) == 2
+    d = events[-1][2]
+    assert d["direction"] == "down" and d["from"] == 4 and d["to"] == 2
+    a.on_worker_set_started()
+    # a pressured tick resets the headroom streak
+    assert a.evaluate(IDLE, running=True, parallelism=2) is None
+    assert a.evaluate(IDLE, running=True, parallelism=2) is None
+    assert a.evaluate(HOT, running=True, parallelism=2) is None
+    assert a.evaluate(IDLE, running=True, parallelism=2) is None
+    assert a.evaluate(IDLE, running=True, parallelism=2) is None
+    # min-parallelism floor: at p=1 a headroom streak decides a no-op,
+    # emits the decision ONCE, and never churns the set
+    n_events = len(events)
+    for _ in range(9):
+        assert a.evaluate(IDLE, running=True, parallelism=1) is None
+    noop = [e for e in events[n_events:] if e[1] == "AUTOSCALE_DECISION"]
+    assert len(noop) == 1 and noop[0][2]["to"] == 1
+
+
+def test_never_scales_while_not_running_or_mid_ckpt_failures():
+    a, _events, _clock = _loop(up_ticks=2, cooldown_s=0.0)
+    for _ in range(5):
+        assert a.evaluate(HOT, running=False, parallelism=2) is None
+    # the counters reset while gated: coming back Running starts over
+    assert a.evaluate(HOT, running=True, parallelism=2) is None
+    # a checkpoint-failure streak gates (and resets) too: the drain
+    # checkpoint a rescale needs is exactly what's wedging
+    assert a.evaluate(HOT, running=True, parallelism=2,
+                      ckpt_failures=1) is None
+    assert a.evaluate(HOT, running=True, parallelism=2) is None
+    assert a.evaluate(HOT, running=True, parallelism=2) == 4
+
+
+def test_backoff_is_exponential_and_resets_on_clean_scale():
+    a, events, clock = _loop(up_ticks=1, cooldown_s=0.0)
+    # attempt 1 disrupted -> 10s window; attempt 2 -> 20s; attempt 3 -> 40s
+    for expected in (10.0, 20.0, 40.0):
+        t = a.evaluate(HOT, running=True, parallelism=2)
+        assert t == 4
+        a.on_scale_disrupted("worker died mid-drain")
+        backoffs = [d for _l, c, d in events if c == "AUTOSCALE_BACKOFF"]
+        assert backoffs[-1]["backoff_s"] == expected
+        a.on_worker_set_started()  # transition still lands at the new scale
+        # gated while the window is open, armed streak fires after
+        assert a.evaluate(HOT, running=True, parallelism=2) is None
+        clock[0] += expected + 1
+    # a CLEAN completion resets the streak back to the base window
+    assert a.evaluate(HOT, running=True, parallelism=2) == 4
+    a.on_worker_set_started()
+    a.evaluate(HOT, running=True, parallelism=2)
+    a.on_scale_disrupted("again")
+    backoffs = [d for _l, c, d in events if c == "AUTOSCALE_BACKOFF"]
+    assert backoffs[-1]["backoff_s"] == 10.0
+
+
+@pytest.mark.chaos
+def test_rails_clamp_forced_bogus_target():
+    """Chaos site autoscale_decide: a forced target far past the bounds
+    must come out clamped; a forced 0 clamps to min-parallelism; drop
+    suppresses the decision entirely."""
+    from arroyo_tpu import faults
+
+    a, events, _clock = _loop(up_ticks=1, cooldown_s=0.0,
+                              min_parallelism=2, max_parallelism=4)
+    faults.install("autoscale_decide:force=64@step=1", seed=3)
+    try:
+        assert a.evaluate(HOT, running=True, parallelism=3) == 4
+        d = events[-1][2]
+        assert d["raw_target"] == 64 and d["to"] == 4 and d["clamped"]
+        a.on_worker_set_started()
+        faults.install("autoscale_decide:force=0@step=1", seed=3)
+        assert a.evaluate(HOT, running=True, parallelism=3) == 2
+        d = events[-1][2]
+        assert d["raw_target"] == 0 and d["to"] == 2 and d["clamped"]
+        a.on_worker_set_started()
+        faults.install("autoscale_decide:drop", seed=3)
+        for _ in range(6):
+            assert a.evaluate(HOT, running=True, parallelism=3) is None
+        assert a.in_flight is None
+        # a raising action costs one tick's decision, never the job
+        faults.install("autoscale_decide:fail_once", seed=3)
+        assert a.evaluate(HOT, running=True, parallelism=3) is None
+        assert a.evaluate(HOT, running=True, parallelism=3) == 4
+    finally:
+        faults.clear()
+
+
+def test_disabled_loop_decides_nothing():
+    from arroyo_tpu import config as cfg
+
+    a, events, _clock = _loop(up_ticks=1, cooldown_s=0.0)
+    cfg.update({"autoscaler.enabled": False})
+    for _ in range(5):
+        assert a.evaluate(HOT, running=True, parallelism=1) is None
+    assert not events
+
+
+# --------------------------------------------- end to end, with goldens
+
+
+def _controller(db, **cfg_over):
+    from arroyo_tpu import config as cfg
+
+    cfg.update(cfg_over)
+    return ControllerServer(db, EmbeddedScheduler()).start()
+
+
+BASE_CFG = {
+    "checkpoint.interval-ms": 150,
+    "testing.source-read-delay-micros": 4000,
+    "autoscaler.enabled": True,
+    "autoscaler.cooldown-s": 0.3,
+}
+RESET_CFG = {
+    "checkpoint.interval-ms": 10_000,
+    "checkpoint.timeout-ms": 600_000,
+    "testing.source-read-delay-micros": 0,
+    "autoscaler.enabled": False,
+    "autoscaler.cooldown-s": 30.0,
+    "autoscaler.up-ticks": 3,
+    "autoscaler.down-ticks": 10,
+    "autoscaler.up-watermark-lag-s": 30.0,
+    "autoscaler.up-queue-transit-p99-ms": 750.0,
+    "autoscaler.up-sink-latency-p99-s": 30.0,
+    "autoscaler.down-busy-max-pct": 25.0,
+    "autoscaler.down-backpressure-max": 0.1,
+    "autoscaler.max-parallelism": 8,
+}
+
+
+@pytest.mark.chaos
+def test_autoscale_up_midstream_golden(tmp_path, _storage):
+    """A running job whose (deliberately hair-trigger) pressure signals
+    breach scales itself 1 -> 2 -> 3 with NO rescale API call: decision,
+    drain behind a final checkpoint, restore at the new parallelism —
+    byte-exact goldens, the full AUTOSCALE event sequence, the target
+    gauge, and the decision detail on the health record."""
+    from arroyo_tpu.metrics import registry
+    from arroyo_tpu.obs.events import trail
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    # smoke input timestamps are historic, so watermark lag is always a
+    # sustained breach: pressure without having to melt this CPU-capped box
+    ctl = _controller(db, **BASE_CFG, **{
+        "autoscaler.up-ticks": 2,
+        "autoscaler.up-watermark-lag-s": 0.001,
+        "autoscaler.max-parallelism": 3,
+        "autoscaler.down-ticks": 10_000,
+    })
+    try:
+        pid = db.create_pipeline("agg", sql, 1)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        seen = set()
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            state = db.get_job(jid)["state"]
+            seen.add(state)
+            if state in ("Finished", "Failed"):
+                break
+            time.sleep(0.02)
+        from arroyo_tpu import config as cfg
+
+        cfg.update({"testing.source-read-delay-micros": 0})
+        assert ctl.wait_for_state(jid, "Finished", timeout=60) == "Finished"
+        assert "Rescaling" in seen, seen
+        # the pipeline rescaled itself to the configured max
+        assert db.get_pipeline(pid)["parallelism"] == 3
+        t = trail(db.list_events(jid))
+        first = {c: t.index(c) for c in set(t)}
+        assert first["AUTOSCALE_DECISION"] < first["AUTOSCALE_STARTED"] \
+            < first["RESCALE"] < first["AUTOSCALE_DONE"], t
+        # two scale-ups (1->2->3), each with its full sequence
+        assert t.count("AUTOSCALE_DONE") == 2, t
+        # the gauge tracked the target
+        text = registry.prometheus_text()
+        assert f'arroyo_autoscaler_target{{job="{jid}"}} 3' in text
+        # /health carries the autoscaler readout incl. the last decision
+        detail = (db.get_health(jid) or {}).get("autoscaler") or {}
+        assert detail.get("enabled") and detail.get("parallelism") == 3
+        assert (detail.get("last_decision") or {}).get("direction") == "up"
+        _assert_golden(out)
+    finally:
+        from arroyo_tpu import config as cfg
+
+        cfg.update(RESET_CFG)
+        ctl.stop()
+
+
+@pytest.mark.chaos
+def test_autoscale_down_repartitions_state_golden(tmp_path, _storage):
+    """Sustained headroom (every pressure ceiling effectively off, the
+    headroom ceilings wide open) scales 3 -> 1: the keyed aggregate's
+    state repartitions across the restore and output stays byte-exact."""
+    from arroyo_tpu.obs.events import trail
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    ctl = _controller(db, **BASE_CFG, **{
+        "autoscaler.down-ticks": 3,
+        "autoscaler.up-watermark-lag-s": 1e12,
+        "autoscaler.up-queue-transit-p99-ms": 1e12,
+        "autoscaler.up-sink-latency-p99-s": 1e12,
+        "autoscaler.down-busy-max-pct": 100.0,
+        "autoscaler.down-backpressure-max": 1.0,
+    })
+    try:
+        pid = db.create_pipeline("agg", sql, 3)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        seen = set()
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            state = db.get_job(jid)["state"]
+            seen.add(state)
+            if state in ("Finished", "Failed"):
+                break
+            time.sleep(0.02)
+        from arroyo_tpu import config as cfg
+
+        cfg.update({"testing.source-read-delay-micros": 0})
+        assert ctl.wait_for_state(jid, "Finished", timeout=60) == "Finished"
+        assert "Rescaling" in seen, seen
+        assert db.get_pipeline(pid)["parallelism"] == 1
+        t = trail(db.list_events(jid))
+        decisions = [e for e in db.list_events(jid)
+                     if e["code"] == "AUTOSCALE_DECISION"]
+        assert decisions[0]["data"]["direction"] == "down"
+        assert decisions[0]["data"]["from"] == 3
+        assert decisions[0]["data"]["to"] == 1
+        assert "AUTOSCALE_DONE" in t
+        _assert_golden(out)
+    finally:
+        from arroyo_tpu import config as cfg
+
+        cfg.update(RESET_CFG)
+        ctl.stop()
+
+
+@pytest.mark.chaos
+def test_worker_crash_during_scale_transition_golden(tmp_path, _storage):
+    """The worker crashes AT the drain barrier of an autoscaler-initiated
+    rescale (periodic checkpoints disabled, so the scale transition's
+    stopping epoch is the only barrier): the transition is disrupted, the
+    autoscaler arms its backoff, the controller still proceeds to the new
+    parallelism from whatever checkpoint exists — and output stays
+    byte-exact because nothing ever went durable."""
+    from arroyo_tpu import faults
+    from arroyo_tpu.obs.events import trail
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    faults.install("worker:crash@step=1", seed=7)
+    ctl = _controller(db, **{**BASE_CFG,
+        "checkpoint.interval-ms": 600_000,  # the drain is the only barrier
+        "autoscaler.up-ticks": 2,
+        "autoscaler.up-watermark-lag-s": 0.001,
+        "autoscaler.max-parallelism": 2,
+    })
+    try:
+        pid = db.create_pipeline("agg", sql, 1)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if db.get_job(jid)["state"] in ("Finished", "Failed"):
+                break
+            time.sleep(0.02)
+        from arroyo_tpu import config as cfg
+
+        cfg.update({"testing.source-read-delay-micros": 0})
+        assert ctl.wait_for_state(jid, "Finished", timeout=90) == "Finished"
+        assert faults.active().fired_log, "barrier crash never fired"
+        t = trail(db.list_events(jid))
+        assert "AUTOSCALE_STARTED" in t and "WORKER_LOST" in t, t
+        assert "AUTOSCALE_BACKOFF" in t, t
+        # disrupted or not, the scale landed
+        assert t.index("WORKER_LOST") < t.index("AUTOSCALE_DONE"), t
+        assert db.get_pipeline(pid)["parallelism"] == 2
+        assert int(db.get_job(jid)["restarts"]) >= 1
+        _assert_golden(out)
+    finally:
+        faults.clear()
+        from arroyo_tpu import config as cfg
+
+        cfg.update(RESET_CFG)
+        ctl.stop()
+
+
+@pytest.mark.chaos
+def test_rescale_command_dropped_watchdog_retries_golden(tmp_path, _storage):
+    """Chaos site `rescale`: the drain trigger of a live rescale is lost
+    mid-transition. The stuck-epoch watchdog must declare the drain epoch
+    failed and re-trigger it (then_stop intact) — the job reaches the new
+    parallelism with byte-exact output instead of wedging in Rescaling."""
+    from arroyo_tpu import faults
+    from arroyo_tpu.obs.events import trail
+
+    sql, out = _sql(tmp_path)
+    db = Database()
+    inj = faults.install("rescale:drop@step=1", seed=11)
+    ctl = _controller(db, **{
+        "checkpoint.interval-ms": 10_000,  # no periodic epochs in the way
+        "checkpoint.timeout-ms": 400,
+        "testing.source-read-delay-micros": 6000,
+    })
+    try:
+        pid = db.create_pipeline("agg", sql, 2)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        time.sleep(0.3)
+        db.update_job(jid, desired_parallelism=3)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(c["state"] == "failed" for c in db.list_checkpoints(jid)):
+                break
+            time.sleep(0.02)
+        assert any(c["state"] == "failed" for c in db.list_checkpoints(jid)), \
+            "dropped drain trigger was never declared wedged"
+        assert inj.fired_log, "rescale drop never fired"
+        from arroyo_tpu import config as cfg
+
+        cfg.update({"testing.source-read-delay-micros": 0})
+        assert ctl.wait_for_state(jid, "Finished", timeout=90) == "Finished"
+        assert db.get_pipeline(pid)["parallelism"] == 3
+        assert "EPOCH_WEDGED" in trail(db.list_events(jid))
+        _assert_golden(out)
+    finally:
+        faults.clear()
+        from arroyo_tpu import config as cfg
+
+        cfg.update(RESET_CFG)
+        ctl.stop()
+
+
+def _run_restart_mid_rescale(tmp_path, clear_desired: bool):
+    """Shared driver: wedge a live rescale mid-drain (dropped trigger, no
+    watchdog), kill the controller, optionally erase desired_parallelism,
+    and let a FRESH controller adopt the Rescaling job."""
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu import faults
+
+    sql, out = _sql(tmp_path)
+    db = Database(str(tmp_path / "ctl.db"))
+    # drop the drain trigger and leave the watchdog off: the job parks in
+    # Rescaling deterministically until the controller dies
+    faults.install("rescale:drop@step=1", seed=13)
+    ctl = _controller(db, **{
+        "checkpoint.interval-ms": 10_000,
+        "checkpoint.timeout-ms": 600_000,
+        "testing.source-read-delay-micros": 10_000,
+    })
+    try:
+        pid = db.create_pipeline("agg", sql, 2)
+        jid = db.create_job(pid)
+        ctl.wait_for_state(jid, "Running", timeout=60)
+        time.sleep(0.3)
+        db.update_job(jid, desired_parallelism=3)
+        ctl.wait_for_state(jid, "Rescaling", timeout=60)
+    finally:
+        ctl.stop()  # kills the draining worker set; job row stays Rescaling
+    faults.clear()
+    assert db.get_job(jid)["state"] == "Rescaling"
+    if clear_desired:
+        db.update_job(jid, desired_parallelism=None)
+    cfg.update({"testing.source-read-delay-micros": 0})
+    ctl2 = ControllerServer(db, EmbeddedScheduler()).start()
+    try:
+        assert ctl2.wait_for_state(jid, "Finished", timeout=120) == "Finished"
+        _assert_golden(out)
+        return db, pid, jid
+    finally:
+        cfg.update(RESET_CFG)
+        ctl2.stop()
+
+
+@pytest.mark.chaos
+def test_controller_restart_mid_rescale_adopts_target(tmp_path, _storage):
+    """A fresh controller adopting a Rescaling job (no worker handles)
+    must finish the rescale from the persisted desired_parallelism — the
+    controller.py:224 adoption path — and produce byte-exact output."""
+    db, pid, _jid = _run_restart_mid_rescale(tmp_path, clear_desired=False)
+    assert db.get_pipeline(pid)["parallelism"] == 3
+
+
+@pytest.mark.chaos
+def test_controller_restart_mid_rescale_desired_unset(tmp_path, _storage):
+    """Adoption with desired_parallelism ALREADY cleared in the DB row:
+    the `_finish_rescale` fallback to self.rescale_to is None on a fresh
+    controller, and the job must degrade to the old parallelism — not
+    crash, not wedge in Rescaling."""
+    db, pid, jid = _run_restart_mid_rescale(tmp_path, clear_desired=True)
+    assert db.get_pipeline(pid)["parallelism"] == 2
+    assert db.get_job(jid)["desired_parallelism"] is None
+
+
+def test_actuation_write_never_clobbers_manual_request(_storage):
+    """The autoscaler actuates via a compare-and-set: its write lands only
+    while no rescale request is pending, so a manual PATCH racing the
+    supervision tick keeps its value (manual requests always win)."""
+    db = Database()
+    pid = db.create_pipeline("p", "CREATE TABLE x (a BIGINT)", 1)
+    jid = db.create_job(pid)
+    assert db.set_desired_parallelism_if_unset(jid, 2) is True
+    # a pending request (here: the one just written) blocks later writes
+    assert db.set_desired_parallelism_if_unset(jid, 4) is False
+    assert db.get_job(jid)["desired_parallelism"] == 2
+    db.clear_desired_parallelism(jid, 2)
+    assert db.set_desired_parallelism_if_unset(jid, 3) is True
+    assert db.get_job(jid)["desired_parallelism"] == 3
+
+
+def test_noop_at_bound_dedups_across_fluctuating_signals():
+    """A job pinned at a bound under sustained overload must emit its
+    no-op decision once per (direction, from, to) — a fluctuating set of
+    breaching signals between hysteresis windows must not re-emit it."""
+    hot_a = {"agg": {"backpressure": 0.95}}
+    hot_b = {"agg": {"backpressure": 0.95,
+                     "watermark_lag_seconds": 1e6}}
+    a, events, _clock = _loop(up_ticks=1, cooldown_s=0.0, max_parallelism=2)
+    for snap in (hot_a, hot_b, hot_a, hot_b):
+        assert a.evaluate(snap, running=True, parallelism=2) is None
+    noop = [e for e in events if e[1] == "AUTOSCALE_DECISION"]
+    assert len(noop) == 1

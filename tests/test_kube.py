@@ -178,3 +178,22 @@ def test_kubernetes_pod_never_registers_times_out(_storage):
     assert "never registered" in events[0]["error"]
     assert len(kube.deleted) == 1  # the orphaned pod is cleaned up
     assert not handle.alive()
+
+
+def test_manifest_probes_and_autoscaler_keys():
+    """k8s/arroyo-tpu.yaml must carry liveness/readiness probes on both
+    tiers (API ping for the control plane, /status for node daemons) and
+    enable the elastic autoscaler with explicit bounds — the manifest is
+    documentation-grade and this keeps it from regressing to dead weight."""
+    path = os.path.join(os.path.dirname(__file__), "..", "k8s",
+                        "arroyo-tpu.yaml")
+    with open(path) as f:
+        text = f.read()
+    assert text.count("livenessProbe") == 2
+    assert text.count("readinessProbe") == 2
+    assert "/api/v1/ping" in text
+    assert "/status" in text
+    assert "ARROYO_TPU__AUTOSCALER__ENABLED" in text
+    assert "ARROYO_TPU__AUTOSCALER__MAX_PARALLELISM" in text
+    readme = os.path.join(os.path.dirname(path), "README.md")
+    assert os.path.exists(readme)

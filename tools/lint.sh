@@ -36,6 +36,7 @@ NAME_RE = re.compile(r"arroyo_(?:worker|checkpoint)_[a-z0-9_]+"
                      r"|arroyo_state_(?:rows|bytes)"
                      r"|arroyo_late_rows_total"
                      r"|arroyo_job_health"
+                     r"|arroyo_autoscaler_[a-z0-9_]+"
                      r"|arroyo_events_total")
 code_names: set[str] = set()
 for p in glob.glob("arroyo_tpu/**/*.py", recursive=True):
@@ -61,11 +62,11 @@ import ast, glob, re, sys
 from arroyo_tpu.obs.events import EVENT_CODES, LEVELS
 
 # every string literal used as an event code at a recorder.record()/
-# JobController._event() call site must be declared in EVENT_CODES, and
-# every declared code must be documented in the README "Events & health"
-# table (AST-walked so formatting can't hide a call site)
+# JobController._event()/Autoscaler._emit() call site must be declared in
+# EVENT_CODES, and every declared code must be documented in the README
+# "Events & health" table (AST-walked so formatting can't hide a call site)
 CODE_RE = re.compile(r"^[A-Z][A-Z_]+$")
-EVENT_CALLS = ("record", "_event")
+EVENT_CALLS = ("record", "_event", "_emit")
 code_sites: set[str] = set()
 for p in glob.glob("arroyo_tpu/**/*.py", recursive=True):
     with open(p) as f:
