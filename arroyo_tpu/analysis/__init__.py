@@ -12,6 +12,16 @@ Two engines share one diagnostic model (``diagnostics.Diagnostic``):
   exceptions, determinism, no host-sync in hot paths, lock discipline,
   fault-site coverage). ``python -m arroyo_tpu lint`` / ``tools/lint.sh``;
   CI keeps it at zero unwaived findings.
+- **Replay-soundness auditor** (``state_audit``, LR2xx): a whole-program
+  class-model pass over every Operator/Source subclass proving hot-path
+  mutable state is checkpoint-covered, side effects are commit-gated,
+  checkpoint/restore table sets agree, and emission never follows raw
+  set/dict order. Runs inside the same ``lint`` sweep; its static
+  coverage verdict is cross-checked at runtime by
+  tests/test_state_audit.py.
+
+``lint --json`` / ``check --json`` emit the diagnostics as a JSON array
+(rule, severity, site, message, fix hint) with unchanged exit codes.
 
 See the README "Static analysis" section for the rule catalog, example
 diagnostics, and how to add a pass or waive a finding.
@@ -26,12 +36,20 @@ from .diagnostics import (  # noqa: F401
     Diagnostic,
     Severity,
     finish,
+    render_json,
     render_report,
     worst,
 )
 from .plan_passes import PLAN_PASSES, PassContext, analyze_graph  # noqa: F401
 from .repo_lint import RULES as LINT_RULES  # noqa: F401
 from .repo_lint import lint_paths, lint_source  # noqa: F401
+from .state_audit import RULES as AUDIT_RULES  # noqa: F401
+from .state_audit import (  # noqa: F401
+    audit_modules,
+    audit_package,
+    audit_source,
+    coverage_for_class,
+)
 
 
 def check_sql(sql: str, parallelism: int = 1):

@@ -38,6 +38,11 @@ class Diagnostic:
             out += f"\n    hint: {self.hint}"
         return out
 
+    def to_dict(self) -> dict:
+        """Machine-readable shape for ``--json`` output / CI annotation."""
+        return {"rule": self.rule_id, "severity": str(self.severity),
+                "site": self.site, "message": self.message, "hint": self.hint}
+
     def sort_key(self):
         # worst first, then stable by site/rule/message so equal inputs
         # always produce byte-identical reports
@@ -75,6 +80,15 @@ def finish(diags: Iterable[Diagnostic]) -> list[Diagnostic]:
 def worst(diags: Iterable[Diagnostic]) -> Optional[Severity]:
     sevs = [d.severity for d in diags]
     return max(sevs) if sevs else None
+
+
+def render_json(diags: list[Diagnostic]) -> str:
+    """Deterministic JSON array of diagnostics (one object per finding:
+    rule, severity, site, message, hint) for ``lint --json``/``check
+    --json`` — CI annotates from this without scraping the text report."""
+    import json
+
+    return json.dumps([d.to_dict() for d in diags], indent=2)
 
 
 def render_report(diags: list[Diagnostic]) -> str:

@@ -23,6 +23,13 @@ Rule catalog (README "Static analysis" section documents each with examples):
     AR007 shuffle-key-consistency   shuffle edges must be keyed upstream
                                     with exactly the keys the consumer
                                     groups by
+    AR008 table-spec-consistency    each node's declared TableSpecs must be
+                                    collision-free (duplicate names would
+                                    share one checkpoint file per subtask)
+                                    and expiring specs must carry the
+                                    operator's configured TTL (a mismatch
+                                    silently widens or narrows the state
+                                    restore window)
 """
 
 from __future__ import annotations
@@ -355,6 +362,62 @@ def pass_shuffle_keys(ctx: PassContext) -> None:
             )
 
 
+def pass_table_specs(ctx: PassContext) -> None:
+    """AR008: instantiate each node's operator (the registered constructor,
+    exactly what the engine will build) and audit its declared TableSpecs.
+
+    Duplicate names within one node collide on the checkpoint path scheme
+    — ``operator-{op}/table-{name}-{subtask}`` — so two tables would write
+    one file and restore would resurrect whichever won. An expiring spec
+    whose retention differs from the operator's configured ``ttl_micros``
+    makes restore load a different horizon than the live operator expires,
+    so recovered state diverges from the state the run would have had.
+    Nodes whose constructor is unavailable here (unregistered connector,
+    missing client package) are skipped — the audit proves what it can
+    see, it does not block planning on optional dependencies."""
+    from ..engine.engine import construct_operator
+
+    for node in ctx.graph.nodes.values():
+        try:
+            # a COPY of the config: constructors may validate-and-mutate
+            # their cfg (e.g. setdefault a Lock), and the analysis probe
+            # must not plant runtime objects into the planned graph
+            op = construct_operator(node.op, dict(node.config))
+            specs = list(op.tables())
+        except Exception:
+            continue
+        seen: dict[str, int] = {}
+        for s in specs:
+            seen[s.name] = seen.get(s.name, 0) + 1
+        for name in sorted(n for n, c in seen.items() if c > 1):
+            ctx.add(
+                "AR008", Severity.ERROR, node.node_id,
+                f"{node.op.value} declares {seen[name]} state tables named "
+                f"{name!r}: the checkpoint path scheme keys files by "
+                "(operator, table, subtask), so they would overwrite each "
+                "other and restore would resurrect only one",
+                "give every TableSpec a unique name within the operator "
+                "(chained members are prefixed c<i>. for exactly this "
+                "reason)",
+            )
+        ttl = node.config.get("ttl_micros")
+        if not ttl:
+            continue
+        ttl = int(ttl)
+        for s in specs:
+            if s.kind != "expiring_time_key" or s.retention_micros == ttl:
+                continue
+            ctx.add(
+                "AR008", Severity.ERROR, node.node_id,
+                f"{node.op.value} is configured with ttl_micros="
+                f"{_fmt_micros(ttl)} but declares table {s.name!r} with "
+                f"retention {_fmt_micros(s.retention_micros)}: restore "
+                "would load a different state horizon than the live "
+                "operator expires",
+                "derive the TableSpec retention from the configured TTL",
+            )
+
+
 PLAN_PASSES: tuple[tuple[str, Callable[[PassContext], None]], ...] = (
     ("edge-schema-consistency", pass_edge_schema),
     ("watermark-safety", pass_watermark_safety),
@@ -362,6 +425,7 @@ PLAN_PASSES: tuple[tuple[str, Callable[[PassContext], None]], ...] = (
     ("retraction-sink-mismatch", pass_retraction_sink),
     ("barrier-reachability", pass_barrier_reachability),
     ("shuffle-key-consistency", pass_shuffle_keys),
+    ("table-spec-consistency", pass_table_specs),
 )
 
 

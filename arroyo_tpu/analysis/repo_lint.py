@@ -62,6 +62,11 @@ Rule catalog:
                                ``logging.getLogger()`` (the root logger,
                                used by logging-INIT code) is exempt
 
+The LR2xx series (replay-soundness audit: checkpoint-coverage of operator
+state, commit-gated side effects, checkpoint/restore table symmetry,
+ordered emission) lives in ``state_audit.py`` and runs as part of every
+``lint_paths`` sweep that touches operators/, windows/, or connectors/.
+
 Waivers: append ``# lint: waive LR1xx — justification`` on the flagged
 line (or the line above). A waiver with no justification text does not
 suppress the finding.
@@ -565,7 +570,10 @@ def lint_paths(paths: list[str], root: Optional[str] = None) -> list[Diagnostic]
 
     When the sweep includes the faults package itself (i.e. a whole-package
     run), additionally checks that every declared fault site is wired at
-    least once somewhere in the sweep (LR106)."""
+    least once somewhere in the sweep (LR106). Modules under the audited
+    operator/window/connector dirs additionally run the replay-soundness
+    auditor (state_audit, LR201-LR204) as one whole-program pass over the
+    sweep, so ``python -m arroyo_tpu lint`` is the single entry point."""
     root = os.path.abspath(root or os.getcwd())
     files: list[str] = []
     for p in paths:
@@ -580,6 +588,7 @@ def lint_paths(paths: list[str], root: Optional[str] = None) -> list[Diagnostic]
     diags: list[Diagnostic] = []
     wired_sites: set[str] = set()
     saw_faults_pkg = False
+    audited: list[ModuleInfo] = []
     for f in files:
         rel = os.path.relpath(f, root).replace(os.sep, "/")
         with open(f) as fh:
@@ -592,8 +601,14 @@ def lint_paths(paths: list[str], root: Optional[str] = None) -> list[Diagnostic]
             continue
         diags.extend(lint_module(mod))
         wired_sites |= _site_literals(mod.tree)
+        if mod.in_dirs("operators", "windows", "connectors"):
+            audited.append(mod)
         if rel.endswith("faults/__init__.py"):
             saw_faults_pkg = True
+    if audited:
+        from .state_audit import audit_modules
+
+        diags.extend(audit_modules(audited)[0])
     if saw_faults_pkg:
         for site in _DECLARED_FAULT_SITES:
             if site not in wired_sites:

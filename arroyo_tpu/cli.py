@@ -37,19 +37,23 @@ def _cmd_visualize(args) -> int:
 def _cmd_check(args) -> int:
     """Static analysis of a pipeline without running it: plan the SQL, run
     every analyzer pass (arroyo_tpu.analysis), print the full diagnostic
-    report. Exit 0 = clean (warnings allowed unless --strict), 1 = rejected."""
+    report (--json: a machine-readable array for CI annotation). Exit 0 =
+    clean (warnings allowed unless --strict), 1 = rejected."""
     import arroyo_tpu
-    from arroyo_tpu.analysis import Severity, check_sql, render_report
+    from arroyo_tpu.analysis import (Severity, check_sql, render_json,
+                                     render_report)
 
     arroyo_tpu._load_operators()
     with open(args.sql_file) as f:
         sql = f.read()
     pp, diags = check_sql(sql, parallelism=args.parallelism)
-    if diags:
+    if args.json:
+        print(render_json(diags))
+    elif diags:
         print(render_report(diags))
     if any(d.severity == Severity.ERROR for d in diags) or pp is None:
         return 1
-    if pp is not None and not diags:
+    if pp is not None and not diags and not args.json:
         print(f"ok: {len(pp.graph.nodes)} nodes, {len(pp.graph.edges)} edges, "
               "no findings")
     if args.strict and diags:
@@ -58,15 +62,20 @@ def _cmd_check(args) -> int:
 
 
 def _cmd_lint(args) -> int:
-    """Repo lint: AST checks over this codebase's own invariants (see
-    arroyo_tpu.analysis.repo_lint). Exit 1 on any unwaived finding."""
+    """Repo lint + replay-soundness audit: AST checks over this codebase's
+    own invariants (arroyo_tpu.analysis.repo_lint + state_audit; --json: a
+    machine-readable array for CI annotation). Exit 1 on any unwaived
+    finding."""
     import arroyo_tpu
-    from arroyo_tpu.analysis import lint_paths, render_report
+    from arroyo_tpu.analysis import lint_paths, render_json, render_report
 
     pkg_dir = os.path.dirname(os.path.abspath(arroyo_tpu.__file__))
     root = os.path.dirname(pkg_dir)
     paths = args.paths or [pkg_dir]
     diags = lint_paths(paths, root=root)
+    if args.json:
+        print(render_json(diags))
+        return 1 if diags else 0
     if diags:
         print(render_report(diags))
         return 1
@@ -728,12 +737,19 @@ def main(argv: Optional[list[str]] = None) -> int:
     kp.add_argument("--parallelism", type=int, default=1)
     kp.add_argument("--strict", action="store_true",
                     help="exit non-zero on warnings too")
+    kp.add_argument("--json", action="store_true",
+                    help="machine-readable diagnostics (rule, severity, "
+                         "site, message, hint); exit codes unchanged")
     kp.set_defaults(fn=_cmd_check)
 
-    lp = sub.add_parser("lint", help="repo lint: AST invariant checks over "
-                                     "this codebase (tools/lint.sh entry)")
+    lp = sub.add_parser("lint", help="repo lint + replay-soundness audit: "
+                                     "AST invariant checks over this "
+                                     "codebase (tools/lint.sh entry)")
     lp.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the arroyo_tpu package)")
+    lp.add_argument("--json", action="store_true",
+                    help="machine-readable diagnostics (rule, severity, "
+                         "site, message, hint); exit codes unchanged")
     lp.set_defaults(fn=_cmd_lint)
 
     cs = sub.add_parser("compile-service",

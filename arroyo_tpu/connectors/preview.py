@@ -37,7 +37,7 @@ class PreviewSink(Operator):
     planner for bare-SELECT results in-process)."""
 
     def __init__(self, cfg: dict):
-        self.rows = cfg.get("rows")
+        self.rows = cfg.get("rows")  # state: ephemeral — debug sink shares a caller-owned list; at-least-once by contract
         self.schema = cfg.get("schema")
 
     def process_batch(self, batch, ctx, collector, input_index=0):

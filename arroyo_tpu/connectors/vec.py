@@ -15,12 +15,12 @@ class VecSink(Operator):
     per-row materialization cost; used by bench.py)."""
 
     def __init__(self, cfg: dict):
-        self.rows: list = cfg["rows"]
+        self.rows: list = cfg["rows"]  # state: ephemeral — test sink appends to a caller-owned list; at-least-once by contract
         self.include_internal = cfg.get("include_internal", False)
         self.columnar = cfg.get("columnar", False)
         # optional shared list: wall_monotonic per appended batch (columnar
         # mode) — the arrival half of the watermark-to-emit latency metric
-        self.arrival_walls: list | None = cfg.get("arrival_walls")
+        self.arrival_walls: list | None = cfg.get("arrival_walls")  # state: ephemeral — bench-only wall-clock probe list
         self._lock = cfg.setdefault("_lock", threading.Lock())
 
     def process_batch(self, batch, ctx, collector, input_index=0):

@@ -17,7 +17,7 @@ from typing import Iterator, Optional
 from urllib.parse import urlparse
 
 from ..batch import Schema
-from ..operators.base import SourceOperator, TableSpec
+from ..operators.base import SourceOperator
 from ..types import SourceFinishType
 from . import register_source
 
@@ -180,8 +180,9 @@ class WebSocketSource(SourceOperator):
         self.endpoint = str(cfg["endpoint"])
         self.subscription = cfg.get("subscription_message")
 
-    def tables(self):
-        return [TableSpec("w", "global_keyed")]
+    # no state tables: this source is non-replayable (no seekable
+    # offset), so there is nothing to snapshot — LR203 rejects a
+    # declared-but-unwired TableSpec
 
     def run(self, sctx, collector) -> SourceFinishType:
         from ..formats.registry import make_deserializer

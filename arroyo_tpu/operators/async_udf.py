@@ -41,7 +41,7 @@ class AsyncUdfOperator(Operator):
         self._pool: Optional[ThreadPoolExecutor] = None
         # (seq, carried_row_cols, future); seq preserves input order
         self._in_flight: list[tuple[int, dict, Future]] = []
-        self._seq = 0
+        self._seq = 0  # state: ephemeral — orders in-flight calls within one incarnation; the in-flight set drains at every barrier
 
     def name(self) -> str:
         return f"async:{self.name_}"
@@ -109,6 +109,7 @@ class AsyncUdfOperator(Operator):
         cols: dict[str, list] = {}
         for _seq, carried, fut in items:
             result = fut.result(timeout=self.timeout_s)
+            # lint: waive LR204 — carried is a per-row dict built in process_batch's column order; identical construction on replay
             for k, v in carried.items():
                 cols.setdefault(k, []).append(v)
             cols.setdefault(self.out_name, []).append(result)

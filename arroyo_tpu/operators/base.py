@@ -63,6 +63,26 @@ class OperatorContext:
         return self.last_watermark.value
 
 
+def persist_mark(ctx: "OperatorContext", table: str, value) -> None:
+    """Write this subtask's scalar meta mark (late-data barrier, event-time
+    high-water, ...) into a global_keyed table — called UNCONDITIONALLY at
+    every barrier, because a mark carried as a column on a state batch is
+    silently dropped whenever the partial snapshot happens to be empty."""
+    ctx.table_manager.global_keyed(table).insert(
+        ctx.task_info.subtask_index, value)
+
+
+def restore_marks(ctx: "OperatorContext", table: str) -> list:
+    """Every prior subtask's non-None mark from a meta table. The merge is
+    the caller's: ``max`` for watermark-aligned boundaries (aligned barriers
+    mean all subtasks saw the same watermark, so max is rescale-safe);
+    data-derived per-subtask marks should prefer their OWN entry
+    (``global_keyed(table).get(subtask_index)``) and fall back to a merge
+    only on rescale."""
+    return [v for _k, v in ctx.table_manager.global_keyed(table).items()
+            if v is not None]
+
+
 class Operator:
     """Mid-pipeline operator (reference ArrowOperator, operator.rs:1074-1183).
 

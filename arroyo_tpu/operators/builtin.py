@@ -144,11 +144,12 @@ class WatermarkGenerator(Operator):
         # optional shared list: (watermark_value, wall_monotonic) appended at
         # each emission — the injection half of the watermark-to-emit
         # latency metric (BASELINE.md; the sink records the arrival half)
-        self.latency_log: Optional[list] = cfg.get("latency_log")
+        self.latency_log: Optional[list] = cfg.get("latency_log")  # state: ephemeral — bench-only latency probe list; never read into emitted data
         self.max_watermark: Optional[int] = None
         self.last_emitted: Optional[int] = None
+        # state: ephemeral — wall-clock idle detection; a restored task re-derives idleness from real time, and idle watermarks carry no data
         self.last_event_wall: float = time.monotonic()  # lint: waive LR109 — event-time idle detection needs a wall clock, not self-measurement
-        self.idle_sent = False
+        self.idle_sent = False  # state: ephemeral — idle latch re-derived from the wall clock after restore; idle watermarks carry no data
 
     def tables(self):
         return [TableSpec("s", "global_keyed")]

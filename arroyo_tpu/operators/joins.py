@@ -28,7 +28,7 @@ from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch
 from ..engine.engine import register_operator
 from ..expr import eval_expr
 from ..graph import OpName
-from ..operators.base import Operator, TableSpec
+from ..operators.base import Operator, TableSpec, persist_mark, restore_marks
 from ..types import Signal
 from .updating_aggregate import IS_RETRACT_FIELD
 
@@ -108,10 +108,10 @@ class InstantJoin(Operator):
         self.device_min_rows = int(config().get("device.join-min-rows", 2048))
         # t -> [left batches], [right batches]
         self.buf: dict[int, tuple[list, list]] = {}
-        self.late_rows = 0
+        self.late_rows = 0  # state: ephemeral — observability counter (obs/profile.py export); never read into emitted data
         self.emitted_before: Optional[int] = None
         # in-flight closes: (JoinHandle|None, t, lb, rb, Watermark|None)
-        self._pending: deque = deque()
+        self._pending: deque = deque()  # state: ephemeral — force-drained at every barrier (handle_checkpoint) before the snapshot
 
     def tables(self):
         return [
@@ -126,9 +126,7 @@ class InstantJoin(Operator):
             for b in tbl.all_batches():
                 self._buffer(b, side)
             tbl.replace_all([])
-        barriers = [
-            v for _k, v in ctx.table_manager.global_keyed("e").items() if v is not None
-        ]
+        barriers = restore_marks(ctx, "e")
         if barriers:
             self.emitted_before = max(barriers)
 
@@ -386,12 +384,12 @@ class InstantJoin(Operator):
         for side, name in ((0, "left"), (1, "right")):
             tbl = ctx.table_manager.expiring_time_key(name)
             batches = []
-            for t, ent in self.buf.items():
-                batches.extend(ent[side])
+            # sorted: snapshot row order feeds _buffer's per-window lists at
+            # restore, so it must not depend on buf's insertion history
+            for t in sorted(self.buf):
+                batches.extend(self.buf[t][side])
             tbl.replace_all(batches)
-        ctx.table_manager.global_keyed("e").insert(
-            ctx.task_info.subtask_index, self.emitted_before
-        )
+        persist_mark(ctx, "e", self.emitted_before)
 
 
 class _SideStore:
@@ -494,7 +492,7 @@ class JoinWithExpiration(Operator):
         # TTL-expired buffered rows dropped from the side stores, exported
         # as arroyo_late_rows_total (counting only — expiry semantics are
         # unchanged)
-        self.late_rows = 0
+        self.late_rows = 0  # state: ephemeral — observability counter (obs/profile.py export); never read into emitted data
 
     def tables(self):
         return [
@@ -748,14 +746,20 @@ class LookupJoin(Operator):
         self.cache_ttl = int(cfg.get("cache_ttl_micros", 60_000_000))
         self.cache_max = int(cfg.get("cache_max_size", 100_000))
         self.max_concurrency = int(cfg.get("max_concurrency", 16))
-        self.cache: dict = {}  # key -> (row|None, wall_micros)
+        # key -> (row|None, wall_micros); checkpointed into table "c" and
+        # restored, so a replayed batch that still hits the cache resolves
+        # to the value the original run emitted. The TTL stays WALL-clock:
+        # entries whose TTL elapsed during recovery downtime re-fetch (and
+        # may see fresher external rows) — a lookup join is only as
+        # replay-stable as its cache is fresh, by design
+        self.cache: dict = {}
         self._pool = None
         # FIFO of ("batch", batch, keys, resolved, missing, fut, borrowed)
         # and ("wm", Watermark) markers: strictly ordered emission
-        self._pending = deque()
+        self._pending = deque()  # state: ephemeral — drained (block=True) at every barrier before the snapshot
         # key -> in-flight Future: concurrent batches borrow a pending
         # fetch instead of re-asking the source for the same key
-        self._inflight: dict = {}
+        self._inflight: dict = {}  # state: ephemeral — emptied by the blocking barrier drain; every future resolves with its batch
 
     def tables(self):
         return [TableSpec("c", "global_keyed")]
@@ -765,6 +769,12 @@ class LookupJoin(Operator):
 
         self._pool = ThreadPoolExecutor(
             max_workers=self.max_concurrency, thread_name_prefix="lookup-join")
+        saved = ctx.table_manager.global_keyed("c").get(
+            ctx.task_info.subtask_index)
+        if saved and not self.cache:
+            # `not self.cache` guards the lazy on_start re-call in
+            # process_batch from clobbering the live cache mid-run
+            self.cache = {k: tuple(v) for k, v in saved}
 
     def process_batch(self, batch, ctx, collector, input_index=0):
         n = batch.num_rows
@@ -781,6 +791,7 @@ class LookupJoin(Operator):
         resolved: dict = {}
         missing: list = []
         borrowed: dict = {}
+        # lint: waive LR204 — populates lookup maps only; emitted rows are ordered by the batch's own key list, and the missing-list order is an external-call detail
         for k in set(keys):
             ent = self.cache.get(k)
             if ent is not None and now - ent[1] <= self.cache_ttl:
@@ -835,13 +846,17 @@ class LookupJoin(Operator):
                 self.cache[k] = (fetched.get(k), now)
                 if self._inflight.get(k) is fut:
                     del self._inflight[k]
+        # lint: waive LR204 — fills the val_of lookup map; row order comes from the batch's key list below
         for k, bf in borrowed.items():
             val_of[k] = bf.result().get(k)
         rows = [val_of[k] for k in keys]
         if len(self.cache) > self.cache_max:
             # evict oldest entries — after gathering, so this batch's keys
             # cannot be evicted before they are read
-            by_age = sorted(self.cache.items(), key=lambda kv: kv[1][1])
+            # key-repr tie-break: same-wall entries must evict identically
+            # on replay (dict order diverges after a restore)
+            by_age = sorted(self.cache.items(),
+                            key=lambda kv: (kv[1][1], str(kv[0])))
             for k, _ in by_age[: len(self.cache) - self.cache_max]:
                 del self.cache[k]
         n = batch.num_rows
@@ -877,6 +892,11 @@ class LookupJoin(Operator):
 
     def handle_checkpoint(self, barrier, ctx, collector):
         self._drain(collector, block=True)
+        # snapshot the cache (sorted by key repr: deterministic file bytes);
+        # nothing is in flight after the blocking drain
+        ctx.table_manager.global_keyed("c").insert(
+            ctx.task_info.subtask_index,
+            sorted(self.cache.items(), key=lambda kv: str(kv[0])))
 
     def on_close(self, ctx, collector):
         self._drain(collector, block=True)

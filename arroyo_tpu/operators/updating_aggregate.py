@@ -27,7 +27,7 @@ from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch
 from ..engine.engine import register_operator
 from ..expr import eval_expr
 from ..graph import OpName
-from ..operators.base import Operator, TableSpec
+from ..operators.base import Operator, TableSpec, persist_mark, restore_marks
 from ..windows.tumbling import acc_plan, dtype_of_from_config
 
 IS_RETRACT_FIELD = "_is_retract"
@@ -59,7 +59,11 @@ class UpdatingAggregate(Operator):
         self.ttl = int(cfg.get("ttl_micros", 24 * 3600 * 1_000_000))
         self.state: dict[int, _KeyState] = {}
         self.key_values: dict[int, tuple] = {}
-        self.updated: set[int] = set()
+        self.updated: set[int] = set()  # state: ephemeral — flushed empty at every barrier (handle_checkpoint flushes first); rebuilt by replay
+        # high-water event time: stamps emitted rows and anchors TTL
+        # eviction; checkpointed into the "m" global table at every barrier
+        # and restored, so replayed emissions carry the same timestamps the
+        # original run emitted
         self.max_event_time: int = 0
         # device lowering (sum/count/avg — the invertible kinds): running
         # accumulators live in HBM as signed scatter lanes (append +v,
@@ -92,12 +96,31 @@ class UpdatingAggregate(Operator):
     # ------------------------------------------------------------------
 
     def tables(self):
-        return [TableSpec("s", "expiring_time_key", retention_micros=self.ttl)]
+        # "m" holds the event-time high-water mark (global: persists even
+        # when the key snapshot is empty, where a column on "s" would be
+        # silently dropped with the 0-row batch)
+        return [TableSpec("s", "expiring_time_key", retention_micros=self.ttl),
+                TableSpec("m", "global_keyed")]
 
     def tick_interval_micros(self):
         return self.flush_interval
 
     def on_start(self, ctx):
+        # event-time high-water mark: stamps emitted rows and anchors TTL
+        # eviction, so replayed emissions carry the original timestamps.
+        # DATA-derived and therefore per-subtask (unlike the watermark-
+        # aligned window boundaries): restore OUR OWN entry so another
+        # subtask's higher mark cannot contaminate this one's emission
+        # timestamps; fall back to the max merge only when our entry is
+        # absent (restore at a different parallelism)
+        own = ctx.table_manager.global_keyed("m").get(
+            ctx.task_info.subtask_index)
+        if own is not None:
+            self.max_event_time = max(self.max_event_time, own)
+        else:
+            marks = restore_marks(ctx, "m")
+            if marks:
+                self.max_event_time = max(self.max_event_time, max(marks))
         tbl = ctx.table_manager.expiring_time_key("s", self.ttl)
         batches = tbl.all_batches()
         if batches and self.device_mode:
@@ -379,8 +402,10 @@ class UpdatingAggregate(Operator):
         idle: list[int] = []
         if evict_before is not None:
             dead_set = set(dead)
-            idle = [h for h, t in self._last_update.items()
-                    if t < evict_before and h not in dead_set]
+            # sorted: see _flush — eviction retraction order must be
+            # replay-stable, and dict order is not after a restore
+            idle = sorted(h for h, t in self._last_update.items()
+                          if t < evict_before and h not in dead_set)
             for h in idle:
                 emitted = self._emitted.pop(h, None)
                 if emitted is not None:
@@ -457,11 +482,17 @@ class UpdatingAggregate(Operator):
             st.emitted = new_vals
         self.updated.clear()
         if evict_before is not None:
-            for h, st in self.state.items():
-                if st.last_update < evict_before and h not in dead:
-                    if st.emitted is not None:
-                        out_rows.append((h, st.emitted, True))
-                    dead.append(h)
+            dead_set = set(dead)
+            # sorted: dict order diverges after a restore (rebuilt in
+            # checkpoint-file order), so eviction retractions must not
+            # leave in iteration order
+            for h in sorted(h for h, st in self.state.items()
+                            if st.last_update < evict_before
+                            and h not in dead_set):
+                st = self.state[h]
+                if st.emitted is not None:
+                    out_rows.append((h, st.emitted, True))
+                dead.append(h)
         if out_rows:
             self._emit(out_rows, collector)
         # evict only after emission so retractions can still resolve key values
@@ -499,6 +530,11 @@ class UpdatingAggregate(Operator):
         # barrier, then snapshot — otherwise un-flushed updates are lost on
         # restore because the `updated` set is not persisted
         self._flush(collector)
+        # high-water mark persists UNCONDITIONALLY (an empty key snapshot
+        # must not lose it — it stamps every emitted row's timestamp). The
+        # RAW value, 0 included: a no-data subtask must restore its own 0,
+        # not fall into the rescale merge and adopt a peer's higher mark
+        persist_mark(ctx, "m", self.max_event_time)
         if self.device_mode:
             self._checkpoint_device(ctx)
             return

@@ -23,7 +23,7 @@ from ..engine.engine import register_operator
 from ..expr import Expr, eval_expr
 from ..graph import OpName
 from ..hashing import hash_columns
-from ..operators.base import Operator, TableSpec
+from ..operators.base import Operator, TableSpec, persist_mark, restore_marks
 
 
 def _sortable(col: np.ndarray, desc: bool) -> np.ndarray:
@@ -61,7 +61,7 @@ class WindowFunctionOperator(Operator):
         self.retain_fields = cfg.get("retain_fields")
         self.buf: dict[int, list[Batch]] = {}
         self.emitted_before: Optional[int] = None
-        self.late_rows = 0
+        self.late_rows = 0  # state: ephemeral — observability counter (obs/profile.py export); never read into emitted data
 
     def tables(self):
         return [
@@ -74,9 +74,7 @@ class WindowFunctionOperator(Operator):
         for b in tbl.all_batches():
             self._buffer(b)
         tbl.replace_all([])
-        barriers = [
-            v for _k, v in ctx.table_manager.global_keyed("e").items() if v is not None
-        ]
+        barriers = restore_marks(ctx, "e")
         if barriers:
             self.emitted_before = max(barriers)
 
@@ -188,9 +186,7 @@ class WindowFunctionOperator(Operator):
     def handle_checkpoint(self, barrier, ctx, collector):
         tbl = ctx.table_manager.expiring_time_key("input")
         tbl.replace_all([b for lst in self.buf.values() for b in lst])
-        ctx.table_manager.global_keyed("e").insert(
-            ctx.task_info.subtask_index, self.emitted_before
-        )
+        persist_mark(ctx, "e", self.emitted_before)
 
 
 @register_operator(OpName.WINDOW_FUNCTION)

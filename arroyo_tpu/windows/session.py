@@ -29,7 +29,7 @@ from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch, object_column
 from ..engine.engine import register_operator
 from ..expr import eval_expr
 from ..graph import OpName
-from ..operators.base import Operator, TableSpec
+from ..operators.base import Operator, TableSpec, persist_mark, restore_marks
 from ..types import Watermark
 from .tumbling import WINDOW_END, WINDOW_START, acc_plan, dtype_of_from_config
 
@@ -78,7 +78,7 @@ class SessionAggregate(Operator):
         # per-key-field value columns; created lazily with the input's dtype
         self.s_keycols: Optional[list[np.ndarray]] = None
         self.emitted_watermark: Optional[int] = None
-        self.late_rows = 0
+        self.late_rows = 0  # state: ephemeral — observability counter (obs/profile.py export); never read into emitted data
 
     # ------------------------------------------------------------------
 
@@ -98,11 +98,8 @@ class SessionAggregate(Operator):
         if batches:
             self._restore_from_batch(Batch.concat(batches))
             tbl.replace_all([])
-        wms = [
-            v["emitted_watermark"]
-            for _k, v in ctx.table_manager.global_keyed("e").items()
-            if v.get("emitted_watermark") is not None
-        ]
+        wms = [v["emitted_watermark"] for v in restore_marks(ctx, "e")
+               if v.get("emitted_watermark") is not None]
         if wms:
             # aligned barriers: every prior subtask saw the same watermark
             self.emitted_watermark = max(wms)
@@ -321,10 +318,7 @@ class SessionAggregate(Operator):
     # ------------------------------------------------------------------
 
     def handle_checkpoint(self, barrier, ctx, collector):
-        ctx.table_manager.global_keyed("e").insert(
-            ctx.task_info.subtask_index,
-            {"emitted_watermark": self.emitted_watermark},
-        )
+        persist_mark(ctx, "e", {"emitted_watermark": self.emitted_watermark})
         tbl = ctx.table_manager.expiring_time_key("s", self.gap)
         n = len(self.s_key)
         if n == 0:

@@ -28,7 +28,7 @@ from ..config import config
 from ..engine.engine import register_operator
 from ..expr import eval_expr
 from ..graph import OpName
-from ..operators.base import Operator, TableSpec
+from ..operators.base import Operator, TableSpec, persist_mark, restore_marks
 from ..types import Watermark
 from .tumbling import (WINDOW_END, WINDOW_START, KeyDictionary, acc_plan,
                        dtype_of_from_config, make_window_aggregator)
@@ -69,7 +69,7 @@ class SlidingAggregate(Operator):
         self.min_bin: Optional[int] = None  # earliest live rel bin
         self.max_bin: Optional[int] = None  # latest rel bin seen
         self.next_window: Optional[int] = None  # rel start-bin of next window to emit
-        self.late_rows = 0
+        self.late_rows = 0  # state: ephemeral — observability counter (obs/profile.py export); never read into emitted data
         # device-path incremental extraction: each slide bin is fetched from
         # the device EXACTLY ONCE (destructively) when the watermark completes
         # it, asynchronously via the shared prefetcher; windows combine the
@@ -77,17 +77,26 @@ class SlidingAggregate(Operator):
         # scan-per-window (measured 38s for 1M events on the remote device
         # link — one ~70ms fetch sync per window close).
         self.open_bins: set[int] = set()  # rel bins with device-resident data
-        self._bin_cache: dict[int, tuple] = {}  # rel bin -> (keys_u64, accs)
-        self._bin_pending: dict = {}  # rel bin -> Future[(keys, bins, accs)]
-        self._extracted_before: Optional[int] = None
-        self._target_window: Optional[int] = None  # emit windows <= this
-        self._wm_queue: list = []  # (target_window, Watermark) held in order
+        self._bin_cache: dict[int, tuple] = {}  # rel bin -> (keys_u64, accs)  # state: ephemeral — folded into the 't' snapshot at every barrier; restore returns those bins to the device store
+        self._bin_pending: dict = {}  # rel bin -> Future[(keys, bins, accs)]  # state: ephemeral — force-resolved at every barrier (handle_checkpoint) before the snapshot
+        # extraction progress (NOT the late boundary): reset on restore so
+        # bins folded back into the device store are re-extracted
+        self._extracted_before: Optional[int] = None  # state: ephemeral — restored bins return to the device store and must re-extract; the late boundary persists separately
+        # late-drop boundary; checkpointed into the "e" global table at
+        # every barrier and restored in on_start, so replay drops exactly
+        # the rows the original run dropped
+        self._late_before: Optional[int] = None
+        self._target_window: Optional[int] = None  # emit windows <= this  # state: ephemeral — re-derived from the first post-restore watermark; emission only reorders against input batches, never against forwarded watermarks
+        self._wm_queue: list = []  # (target_window, Watermark) held in order  # state: ephemeral — fully drained by the forced _drain at every barrier
 
     # ------------------------------------------------------------------
 
     def tables(self):
-        # a bin's partials live until the last window containing it closes
-        return [TableSpec("t", "expiring_time_key", retention_micros=self.width)]
+        # a bin's partials live until the last window containing it closes;
+        # "e" holds the late-drop boundary (global: survives an empty
+        # partial snapshot, where a column on the "t" batch would vanish)
+        return [TableSpec("t", "expiring_time_key", retention_micros=self.width),
+                TableSpec("e", "global_keyed")]
 
     def _aggregator(self):
         if self._agg is None:
@@ -122,6 +131,15 @@ class SlidingAggregate(Operator):
         if batches:
             self._restore_from_batch(Batch.concat(batches))
             tbl.replace_all([])
+        # late-drop boundary (ABSOLUTE slide bin): replay must drop exactly
+        # the rows the original run dropped; max merges subtasks/rescales
+        barriers = restore_marks(ctx, "e")
+        if barriers:
+            lb_abs = max(barriers)
+            if self.base_bin is None:
+                # empty partial snapshot: anchor the bin space at the boundary
+                self.base_bin = lb_abs
+            self._late_before = lb_abs - self.base_bin
 
     def _restore_from_batch(self, b: Batch) -> None:
         if self.lane_key_fields is None:
@@ -164,9 +182,9 @@ class SlidingAggregate(Operator):
         # path) the bin was already destructively extracted — both are
         # watermark-contract violations by the producer
         late_before = self.next_window
-        if self._extracted_before is not None:
-            late_before = (self._extracted_before if late_before is None
-                           else max(late_before, self._extracted_before))
+        if self._late_before is not None:
+            late_before = (self._late_before if late_before is None
+                           else max(late_before, self._late_before))
         if late_before is not None:
             late = rel < late_before
             if late.any():
@@ -256,6 +274,8 @@ class SlidingAggregate(Operator):
                 self._bin_pending[b] = pf.submit(handle.result)
                 self.open_bins.discard(b)
         self._extracted_before = complete_before
+        if self._late_before is None or complete_before > self._late_before:
+            self._late_before = complete_before
 
     def _resolve_bins(self, bins: list[int], force: bool) -> bool:
         """Move resolved futures into the cache; True when every requested
@@ -310,6 +330,7 @@ class SlidingAggregate(Operator):
                 keys_c, accs_c = combine_by_key(self.acc_kinds, keys, accs)
                 fused.append(self._window_cols(w, keys_c, accs_c))
             self.next_window = w + 1
+            # lint: waive LR204 — eviction only: deletes closed cache bins; no row is built or emitted from this loop
             for b in [b for b in self._bin_cache if b < self.next_window]:
                 del self._bin_cache[b]
             self.key_dict.evict_closed(self.next_window)
@@ -411,6 +432,16 @@ class SlidingAggregate(Operator):
         # still feeding future windows — into the snapshot
         self._drain(collector, force=True)
         self._resolve_bins(sorted(self._bin_pending), force=True)
+        # the late-drop boundary persists UNCONDITIONALLY — an empty
+        # partial snapshot must not lose it. Fold in next_window: on the
+        # numpy backend the live late filter is next_window itself
+        # (_late_before is device-path-only), and its __next_window column
+        # vanishes with an empty snapshot
+        rel_marks = [m for m in (self._late_before, self.next_window)
+                     if m is not None]
+        persist_mark(ctx, "e",
+                     None if not rel_marks
+                     else max(rel_marks) + (self.base_bin or 0))
         tbl = ctx.table_manager.expiring_time_key("t", self.width)
         if self._agg is None:
             # no data yet: building the aggregator now would freeze

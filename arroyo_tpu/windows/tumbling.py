@@ -30,7 +30,7 @@ from ..config import config
 from ..engine.engine import register_operator
 from ..expr import Col, Expr, eval_expr
 from ..graph import OpName
-from ..operators.base import Operator, TableSpec
+from ..operators.base import Operator, TableSpec, persist_mark, restore_marks
 from ..types import Signal, Watermark
 
 WINDOW_START = "window_start"
@@ -302,17 +302,25 @@ class TumblingAggregate(Operator):
         self.key_dict = KeyDictionary([])
         self.base_bin: Optional[int] = None  # micros bin offset for int32 device bins
         self.open_bins: set[int] = set()  # relative bins resident on device
-        self.emitted_before_rel: Optional[int] = None  # late-data boundary
-        self.late_rows = 0  # dropped as later than an emitted window
+        # late-data boundary; checkpointed into the "e" global table at
+        # every barrier and restored in on_start (replay must drop exactly
+        # the rows the original run dropped)
+        self.emitted_before_rel: Optional[int] = None
+        self.late_rows = 0  # state: ephemeral — observability counter (obs/profile.py export); never read into emitted data
         # in-flight closes: (ExtractHandle|None, rel_before|None, Watermark|None)
-        self._pending: deque = deque()
-        self._batch_seq = 0
+        self._pending: deque = deque()  # state: ephemeral — force-drained at every barrier (handle_checkpoint) before the snapshot
+        self._batch_seq = 0  # state: ephemeral — orders in-flight closes within one incarnation; the queue is empty at every barrier
 
     # ------------------------------------------------------------------
 
     def tables(self):
-        # retention = width: a bin's partials live until its window closes
-        return [TableSpec("t", "expiring_time_key", retention_micros=self.width)]
+        # retention = width: a bin's partials live until its window closes;
+        # "e" holds the late-data barrier (same convention as session/
+        # window_fn/InstantJoin) — global, so it survives an EMPTY partial
+        # snapshot (every window closed at the barrier) where a column on
+        # the "t" batch would be silently dropped
+        return [TableSpec("t", "expiring_time_key", retention_micros=self.width),
+                TableSpec("e", "global_keyed")]
 
     def _setup_key_transport(self, batch: Batch) -> None:
         """Split group-by columns by dtype: numeric values are carried in HBM
@@ -349,6 +357,17 @@ class TumblingAggregate(Operator):
             restored = Batch.concat(batches)
             self._restore_from_batch(restored)
             tbl.replace_all([])
+        # late-data boundary (ABSOLUTE bin): replay must drop exactly the
+        # rows the original run dropped, or window contents diverge after a
+        # restore. Watermark-aligned, so max merges subtasks/rescales.
+        barriers = restore_marks(ctx, "e")
+        if barriers:
+            eb_abs = max(barriers)
+            if self.base_bin is None:
+                # empty partial snapshot (every window closed at the
+                # barrier): anchor the bin space at the boundary itself
+                self.base_bin = eb_abs
+            self.emitted_before_rel = eb_abs - self.base_bin
 
     def _restore_from_batch(self, b: Batch) -> None:
         # checkpoints carry every key field as a named column, so the
@@ -537,6 +556,11 @@ class TumblingAggregate(Operator):
         # flush in-flight emissions first: their rows/watermarks must precede
         # the barrier, and the snapshot must not race follow-up extractions
         self._drain_pending(collector, force=True)
+        # the late-data barrier persists UNCONDITIONALLY — an empty partial
+        # snapshot (all windows closed) must not lose the boundary
+        persist_mark(ctx, "e",
+                     None if self.emitted_before_rel is None
+                     else self.emitted_before_rel + (self.base_bin or 0))
         tbl = ctx.table_manager.expiring_time_key("t", self.width)
         if self._agg is None:
             # no data yet: building the aggregator here would freeze acc_kinds

@@ -1,0 +1,27 @@
+-- reject: AR008
+-- The bad_state test connector (tests/smoke/udfs.py) declares TWO state
+-- tables named 's': the checkpoint path scheme keys files by
+-- (operator, table, subtask), so the tables would overwrite each other's
+-- snapshots and restore would resurrect only one. The plan analyzer
+-- instantiates each node's operator and rejects the collision before any
+-- state is allocated.
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'bad_state',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE output (
+  counter BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO output SELECT counter FROM impulse_source;

@@ -31,3 +31,21 @@ register_udaf("p90", p90, return_dtype="float64")
 register_udaf("val_range", val_range, return_dtype="int64")
 register_udf("double_negative", double_negative, return_dtype="int64",
              is_async=True, max_concurrency=16, ordered=True)
+
+
+# --- AR008 fixture connector ------------------------------------------------
+# A deliberately mis-declared source: two state tables sharing one name.
+# This is the operator-author bug class AR008 (table-spec-consistency)
+# rejects at plan time; queries_bad/duplicate_table_specs.sql drives it.
+from arroyo_tpu.connectors import register_source
+from arroyo_tpu.connectors.single_file import SingleFileSource
+from arroyo_tpu.operators.base import TableSpec
+
+
+class BadStateSource(SingleFileSource):
+    def tables(self):
+        return [TableSpec("s", "global_keyed"),
+                TableSpec("s", "expiring_time_key")]
+
+
+register_source("bad_state")(BadStateSource)

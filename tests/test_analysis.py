@@ -84,11 +84,24 @@ def errors(diags):
 # ------------------------------------------------------------ catalog tests
 
 
+def _register_smoke_fixtures():
+    """Import the smoke suite's UDF/connector fixtures (idempotent): the
+    AR008 catalog entry plans a deliberately-broken test connector that
+    tests/smoke/udfs.py registers."""
+    import sys
+    sys.path.insert(0, SMOKE)
+    try:
+        import udfs  # noqa: F401
+    finally:
+        sys.path.pop(0)
+
+
 @pytest.mark.parametrize("path", BAD_FILES, ids=[os.path.basename(p)[:-4] for p in BAD_FILES])
 def test_known_bad_catalog(path):
     """Every cataloged bad pipeline produces exactly its annotated
     diagnostic: 'reject' entries fail `check` with that rule id as an
     ERROR, 'warn' entries plan successfully but carry the warning."""
+    _register_smoke_fixtures()
     sql, mode, rule = load_bad(path)
     pp, diags = check_sql(sql)
     if mode == "reject":
@@ -104,12 +117,7 @@ def test_all_smoke_families_accepted():
     """The analyzer must not reject any golden-output family."""
     from arroyo_tpu.sql import plan_query
 
-    import sys
-    sys.path.insert(0, SMOKE)
-    try:
-        import udfs  # noqa: F401
-    finally:
-        sys.path.pop(0)
+    _register_smoke_fixtures()
     for p in sorted(glob.glob(os.path.join(SMOKE, "queries", "*.sql"))):
         sql = open(p).read().replace("$input_dir", os.path.join(SMOKE, "inputs")) \
             .replace("$output_path", "/tmp/qa_out.json")

@@ -443,9 +443,19 @@ FAIL_QUERIES = sorted(
 @pytest.mark.parametrize("name", FAIL_QUERIES)
 def test_smoke_fail(name, tmp_path):
     import re
+    import sys
 
     from arroyo_tpu.sql import plan_query
     from arroyo_tpu.sql.lexer import SqlError
+
+    # register the suite's fixture UDFs/connectors (duplicate_table_specs
+    # plans the deliberately-broken 'bad_state' connector): without this,
+    # standalone runs of this file would skip AR008's node and not reject
+    sys.path.insert(0, SMOKE)
+    try:
+        import udfs  # noqa: F401
+    finally:
+        sys.path.pop(0)
 
     path = os.path.join(SMOKE, "queries_bad", f"{name}.sql")
     with open(path) as f:

@@ -15,6 +15,11 @@
 #                              (obs/events.py EVENT_CODES, cross-checked
 #                              against code-site literals) is documented in
 #                              the README "Events & health" table
+#   tools/lint.sh --rules-catalog
+#                              assert every LR/AR rule id registered in the
+#                              analysis engines (repo_lint.RULES,
+#                              state_audit.RULES, plan-pass AR literals)
+#                              appears in the README rule tables
 #
 # Exit non-zero on any unwaived lint finding or unexpected check result.
 set -euo pipefail
@@ -101,6 +106,38 @@ if missing:
     sys.exit(1)
 print(f"events-catalog: ok ({len(EVENT_CODES)} event codes documented, "
       f"{len(code_sites)} emitted in code)")
+EOF
+fi
+
+if [[ "${1:-}" == "--rules-catalog" ]]; then
+    python - <<'EOF'
+import ast, re, sys
+
+from arroyo_tpu.analysis import AUDIT_RULES, LINT_RULES
+
+# every rule id an analysis engine can emit: the two registered rule
+# tables, plus AR-series literals AST-walked out of the plan passes (they
+# register by function, not id) — each must appear in a README rule table
+rule_ids = {rid for rid, _sev, _fn in LINT_RULES} | set(AUDIT_RULES)
+ID_RE = re.compile(r"^(AR|LR)\d{3}$")
+for p in ("arroyo_tpu/analysis/plan_passes.py",
+          "arroyo_tpu/analysis/__init__.py"):
+    with open(p) as f:
+        tree = ast.parse(f.read(), p)
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and ID_RE.match(n.value):
+            rule_ids.add(n.value)
+with open("README.md") as f:
+    readme = f.read()
+missing = sorted(r for r in rule_ids if f"`{r}`" not in readme)
+if missing:
+    print("rules-catalog: rule ids registered in code but missing from the "
+          "README 'Static analysis' tables:")
+    for r in missing:
+        print(f"  {r}")
+    sys.exit(1)
+print(f"rules-catalog: ok ({len(rule_ids)} rule ids documented)")
 EOF
 fi
 
