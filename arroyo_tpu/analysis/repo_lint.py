@@ -61,6 +61,17 @@ Rule catalog:
                                structured-events bridge setup. Bare
                                ``logging.getLogger()`` (the root logger,
                                used by logging-INIT code) is exempt
+    LR111 jit-in-hot-path      ``jax.jit`` / ``pjit`` invocation inside an
+                               operator hot-path method (process_batch /
+                               handle_watermark / handle_tick): a per-batch
+                               jit builds a fresh callable and re-traces +
+                               XLA-compiles on every call — the classic
+                               silent perf bug the whole-segment compiler
+                               exists to prevent. Compiled callables belong
+                               in the segment-compiler cache (engine/
+                               segment.py) or a once-per-config builder
+                               (ops/slot_agg.py _build_slot_jax); hot
+                               paths only CALL them
 
 The LR2xx series (replay-soundness audit: checkpoint-coverage of operator
 state, commit-gated side effects, checkpoint/restore table symmetry,
@@ -511,6 +522,41 @@ def rule_lr110(mod: ModuleInfo) -> Iterable[Finding]:
                        "getLogger(\"arroyo_tpu...\")` and use _log here")
 
 
+_LR111_HOT_METHODS = ("process_batch", "process_batches", "handle_watermark",
+                      "handle_tick")
+_LR111_JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit",
+                    "jax.experimental.pjit.pjit")
+
+
+def rule_lr111(mod: ModuleInfo) -> Iterable[Finding]:
+    """jit/pjit invocation inside operator hot paths. ``jax.jit(fn)`` per
+    batch builds a fresh jitted callable whose trace cache dies with it —
+    every batch pays a full retrace + XLA compile (tens of ms) that
+    profiles as 'process' self-time and silently eats the win it was meant
+    to buy. Compiled callables are built once per (segment, schema) in the
+    segment-compiler cache, or once per operator config; hot paths only
+    CALL them."""
+    if not mod.in_dirs("operators", "windows", "ops"):
+        return
+    for fn in ast.walk(mod.tree):
+        if not (isinstance(fn, ast.FunctionDef)
+                and fn.name in _LR111_HOT_METHODS):
+            continue
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            dn = _dotted(n.func)
+            if dn in _LR111_JIT_NAMES or dn.endswith((".jit", ".pjit")):
+                yield (n.lineno,
+                       f"{dn}() inside {fn.name}: a per-batch jit builds a "
+                       "fresh callable and re-traces/compiles on every "
+                       "batch — the retrace-per-batch bug the segment "
+                       "compiler (engine/segment.py) exists to prevent",
+                       "build the jitted callable once — in the segment-"
+                       "compiler cache or a per-config builder — and only "
+                       "call it from the hot path")
+
+
 RULES: tuple[tuple[str, Severity, object], ...] = (
     ("LR101", Severity.ERROR, rule_lr101),
     ("LR102", Severity.ERROR, rule_lr102),
@@ -522,6 +568,7 @@ RULES: tuple[tuple[str, Severity, object], ...] = (
     ("LR108", Severity.ERROR, rule_lr108),
     ("LR109", Severity.ERROR, rule_lr109),
     ("LR110", Severity.ERROR, rule_lr110),
+    ("LR111", Severity.ERROR, rule_lr111),
 )
 
 # fault sites every full-package lint must find wired (mirrors faults.SITES;

@@ -182,7 +182,12 @@ def spec() -> dict:
                                    "type": "object", "properties": {
                                        "id": _STR, "op": _STR,
                                        "description": _STR,
-                                       "parallelism": _INT}}},
+                                       "parallelism": _INT,
+                                       # chained run marked for whole-
+                                       # segment compilation (plan-time;
+                                       # runtime truth is the profile's
+                                       # segment_compiled flag)
+                                       "compilable": {"type": "boolean"}}}},
                                "edges": {"type": "array", "items": {
                                    "type": "object", "properties": {
                                        "src": _STR, "dst": _STR,

@@ -289,6 +289,16 @@ class Task:
         op: Operator = self.operator  # type: ignore[assignment]
         prof = self.profiler
         op.on_start(self.ctx)
+        # whole-segment compilation (engine/segment.py): a chained run
+        # marked compilable at plan time processes batches through ONE
+        # jitted call instead of the per-member hook loop; the runner owns
+        # compile/verify/fallback and delegates to op.process_batch when
+        # the segment is (or becomes) interpreted. Signals below always
+        # take the interpreted hooks.
+        from .segment import runner_for
+
+        runner = runner_for(op, self.ctx, self.metrics)
+        process = op.process_batch if runner is None else runner.process_batch
         holder = WatermarkHolder(self.n_inputs)
         finished: set[int] = set()
         blocked: set[int] = set()
@@ -425,7 +435,7 @@ class Task:
                 self.metrics.add("arroyo_worker_messages_recv", item.num_rows)
                 self.metrics.add("arroyo_worker_bytes_recv", item.nbytes())
                 if prof is None:
-                    op.process_batch(item, self.ctx, self.collector, input_index=idx)
+                    process(item, self.ctx, self.collector, input_index=idx)
                 else:
                     if self.observe_input_keys and KEY_FIELD in item:
                         # keyed-insert boundary of the skew sketch
@@ -433,7 +443,7 @@ class Task:
                         # shuffle boundary instead — never both)
                         prof.observe_keys(item.keys)
                     t0 = prof.begin()
-                    op.process_batch(item, self.ctx, self.collector, input_index=idx)
+                    process(item, self.ctx, self.collector, input_index=idx)
                     prof.end("process", t0)
                 if self._terminal and item.num_rows:
                     self._observe_sink_latency(item)

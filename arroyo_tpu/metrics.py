@@ -112,7 +112,7 @@ class TaskMetrics:
                  "queue_rem", "emit_batch_rows", "queue_transit",
                  "sink_event_latency", "watermark_micros", "self_time",
                  "self_cpu", "late_rows", "state_rows", "state_bytes",
-                 "sketch", "started_monotonic")
+                 "sketch", "started_monotonic", "segment_compiled")
 
     def __init__(self, job_id: str, node_id: str, subtask: int):
         self.job_id = job_id
@@ -145,6 +145,11 @@ class TaskMetrics:
         self.state_bytes: dict[str, int] = {}
         self.sketch = None
         self.started_monotonic = time.monotonic()
+        # whole-segment compilation (engine/segment.py): True once this
+        # subtask's chained segment runs as one jitted call, False after a
+        # fallback, None for operators the compiler never considered —
+        # `top` and `explain` render the [compiled] marker from this
+        self.segment_compiled: Optional[bool] = None
 
     def histogram(self, name: str) -> Histogram:
         # explicit mapping: an unknown/typoed name must fail loudly at the
@@ -191,6 +196,11 @@ class MetricsRegistry:
         # autoscaler (controller/autoscaler.py) when enabled: the in-flight
         # target while a scale actuates, else the current parallelism
         self._autoscaler_target: dict[str, int] = {}
+        # whole-segment compilation (engine/segment.py): per-job histogram
+        # of trace+XLA-compile wall seconds (one observation per compiled
+        # (segment, schema, padded-shape)), and the compile-cache hit count
+        self._segment_compile: dict[str, Histogram] = {}
+        self._segment_cache_hits: dict[str, int] = {}
 
     def set_job_health(self, job_id: str, state: str) -> None:
         with self._lock:
@@ -208,6 +218,25 @@ class MetricsRegistry:
                 tm = TaskMetrics(job_id, node_id, subtask)
                 self._tasks[key] = tm
             return tm
+
+    def observe_segment_compile(self, job_id: str, seconds: float) -> None:
+        with self._lock:
+            h = self._segment_compile.get(job_id)
+            if h is None:
+                h = self._segment_compile[job_id] = Histogram(PHASE_BUCKETS)
+            h.observe(float(seconds))
+
+    def add_segment_cache_hit(self, job_id: str) -> None:
+        with self._lock:
+            self._segment_cache_hits[job_id] = \
+                self._segment_cache_hits.get(job_id, 0) + 1
+
+    def segment_compile_stats(self, job_id: str) -> tuple[int, int]:
+        """(compiles observed, cache hits) for one job — test/CLI probe."""
+        with self._lock:
+            h = self._segment_compile.get(job_id)
+            return (h.count if h else 0,
+                    self._segment_cache_hits.get(job_id, 0))
 
     def observe_epoch_phases(self, job_id: str, phases: dict) -> None:
         """Record one completed epoch's phase durations (seconds)."""
@@ -238,6 +267,8 @@ class MetricsRegistry:
             }
             self._job_health.pop(job_id, None)
             self._autoscaler_target.pop(job_id, None)
+            self._segment_compile.pop(job_id, None)
+            self._segment_cache_hits.pop(job_id, None)
 
     def prometheus_text(self) -> str:
         """Prometheus exposition format (served at /metrics)."""
@@ -326,6 +357,20 @@ class MetricsRegistry:
             phase_hists = sorted(self._phases.items())
             job_health = sorted(self._job_health.items())
             autoscaler_targets = sorted(self._autoscaler_target.items())
+            segment_compiles = sorted(self._segment_compile.items())
+            segment_hits = sorted(self._segment_cache_hits.items())
+        # whole-segment compilation (engine/segment.py): compile-time
+        # distribution + compile-cache hits per job
+        if segment_compiles:
+            lines.append("# TYPE arroyo_segment_compile_seconds histogram")
+            for job, h in segment_compiles:
+                emit_histogram("arroyo_segment_compile_seconds",
+                               f'job="{job}"', h)
+        if segment_hits:
+            lines.append("# TYPE arroyo_segment_cache_hits_total counter")
+            for job, n in segment_hits:
+                lines.append(
+                    f'arroyo_segment_cache_hits_total{{job="{job}"}} {n}')
         if phase_hists:
             lines.append("# TYPE arroyo_checkpoint_phase_seconds histogram")
             for (job, phase), h in phase_hists:
@@ -395,6 +440,8 @@ class MetricsRegistry:
                 "state_rows": dict(t.state_rows),
                 "state_bytes": dict(t.state_bytes),
             }
+            if t.segment_compiled is not None:
+                entry["segment_compiled"] = t.segment_compiled
             if t.sketch is not None and t.sketch.total:
                 # fixed-width hex: merges deterministically (merge_topk) and
                 # survives JSON without 64-bit precision loss
@@ -435,6 +482,8 @@ def _op_aggregate(per_subtask: dict[str, dict]) -> dict:
         "per_subtask": per_subtask,
         **aggregate_profiles(per_subtask),
     }
+    if any(s.get("segment_compiled") for s in per_subtask.values()):
+        out["segment_compiled"] = True
     process_s = (out.get("self_time") or {}).get("process")
     recv = out.get("arroyo_worker_messages_recv", 0)
     if process_s and recv:

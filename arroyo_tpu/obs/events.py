@@ -78,6 +78,14 @@ EVENT_CODES: dict[str, tuple[str, str]] = {
     "HEALTH_OK": (
         "INFO", "all health rules cleared their hysteresis window; the job "
                 "is healthy again"),
+    "SEGMENT_COMPILED": (
+        "INFO", "a chained operator segment compiled into one jitted batch "
+                "function; data carries member count, compile time, and "
+                "the input schema the cache entry is keyed on"),
+    "SEGMENT_FALLBACK": (
+        "WARN", "a marked segment could not trace (or its first-batch "
+                "verification diverged) and degraded to the interpreted "
+                "per-operator path for this run; data carries the reason"),
     "LOG": (
         "INFO", "a stdlib logging record carrying job context, bridged by "
                 "the logging.capture-events handler"),

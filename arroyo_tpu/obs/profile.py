@@ -230,6 +230,8 @@ def job_profile(metrics: Optional[dict]) -> dict:
             "hot_keys": m.get("hot_keys") or [],
             "per_subtask": per,
         }
+        if m.get("segment_compiled"):
+            out[op]["segment_compiled"] = True
     return out
 
 
@@ -294,6 +296,10 @@ def _annotations(prof: dict) -> list[str]:
     lines = []
     head = (f"busy {prof['busy_pct']:.1f}%" if prof.get("busy_pct") is not None
             else "busy -")
+    if prof.get("segment_compiled"):
+        # whole-segment compilation: this row's self-time is ONE jitted
+        # dispatch covering every chained member, not a per-member sum
+        head = "[compiled] " + head
     head += (f"   in {_fmt_rate(prof.get('rows_in_per_sec'))}"
              f"   out {_fmt_rate(prof.get('rows_out_per_sec'))}")
     st = prof.get("self_time") or {}

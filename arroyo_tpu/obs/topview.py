@@ -73,7 +73,9 @@ def render(job: dict, metrics: Optional[dict],
         hot_s = (f"{hot['key'][:6]}.. {100 * hot.get('share', 0):.0f}%"
                  if hot.get("key") else "-")
         rows.append((
-            op,
+            # whole-segment compilation: this chained operator's batches run
+            # as one jitted dispatch (its busy% is not a per-member sum)
+            op + (" [compiled]" if m.get("segment_compiled") else ""),
             str(m.get("subtasks", len(m.get("per_subtask", {})) or 1)),
             _fmt_rate(m.get("messages_recv_per_sec")),
             _fmt_rate(m.get("messages_per_sec")),

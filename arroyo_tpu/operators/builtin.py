@@ -177,9 +177,17 @@ class WatermarkGenerator(Operator):
         n = batch.num_rows
         vals = np.asarray(eval_expr(self.expr, batch.columns, n))
         m = int(vals.max())
+        collector.collect(batch)
+        self.observe_batch_max(m, collector)
+
+    def observe_batch_max(self, m: int, collector) -> None:
+        """Watermark state machine over one batch's max event-time value —
+        shared by the interpreted hook above and the compiled segment's
+        host finisher (engine/segment.py), so the two paths cannot drift.
+        Called AFTER the batch's rows are collected: the emitted watermark
+        must never overtake the data it covers."""
         self.last_event_wall = time.monotonic()  # lint: waive LR109 — idle-detection clock, not self-measurement
         self.idle_sent = False
-        collector.collect(batch)
         if self.max_watermark is None or m > self.max_watermark:
             self.max_watermark = m
             if self.last_emitted is None or m - self.last_emitted >= self.interval_micros:

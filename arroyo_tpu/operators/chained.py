@@ -8,7 +8,11 @@ ChainedCollector threading output of op N into op N+1 in place :370-422).
 
 On this engine a chain collapses per-batch queue hops and thread handoffs —
 the host-side analog of XLA op fusion, and a direct throughput lever since
-every hop costs a bounded-queue put/get plus a GIL switch.
+every hop costs a bounded-queue put/get plus a GIL switch. A chained run
+marked compilable at plan time additionally runs its data path as ONE
+jitted call per micro-batch (engine/segment.py whole-segment compilation);
+this class stays the interpreted ground truth the compiled path verifies
+against and falls back to.
 
 Interplay with micro-batch coalescing (operators/collector.py): member-to-
 member hops are plain in-process calls, so there is deliberately NO
@@ -79,6 +83,11 @@ class ChainedOperator(Operator):
         self.members: list[Operator] = [
             construct_operator(OpName(op), c) for op, c in cfg["members"]
         ]
+        # raw member (op, config) pairs + the optimizer's plan-time
+        # compilability marking: engine/segment.py keys its compile cache
+        # off these and traces the marked prefix into one jitted call
+        self.cfg_members: list = list(cfg["members"])
+        self.compile_marking: Optional[dict] = cfg.get("compile")
         self._ctxs: Optional[list[OperatorContext]] = None
         self._cols = None
         # only members that declared a tick interval get ticked: the chain

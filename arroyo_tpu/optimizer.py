@@ -74,9 +74,18 @@ def chain_graph(g: Graph) -> Graph:
         fid = "+".join(run)
         for nid in run:
             rep[nid] = fid
-        fused_cfg[fid] = {
-            "members": [(g.nodes[nid].op.value, g.nodes[nid].config) for nid in run]
-        }
+        members = [(g.nodes[nid].op.value, g.nodes[nid].config) for nid in run]
+        fused_cfg[fid] = {"members": members}
+        # plan-time compilability marking (engine/segment.py): the maximal
+        # traceable prefix of the run, judged statically from op kinds and
+        # expression shapes. The runtime still gates on real column dtypes
+        # and verifies the first batch — this marking only says "worth
+        # attempting", so an unmarked chain never pays a compile probe
+        from .engine.segment import segment_marking
+
+        marking = segment_marking(members)
+        if marking is not None:
+            fused_cfg[fid]["compile"] = marking
 
     out = Graph()
     for nid, node in g.nodes.items():

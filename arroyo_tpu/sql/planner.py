@@ -1412,9 +1412,16 @@ def executed_graph_view(sql: str, parallelism: int = 1,
         from ..optimizer import chain_graph
 
         g = chain_graph(g)
+    compile_on = _cfg().get("segment.compile.enabled", True)
     nodes = [{"id": n.node_id, "op": n.op.value,
               "description": n.description or n.op.value,
-              "parallelism": n.parallelism}
+              "parallelism": n.parallelism,
+              # plan-time marking (optimizer.chain_graph): this chained run
+              # will be offered to the whole-segment compiler. Runtime truth
+              # (compiled vs fell back) rides the profile's
+              # ``segment_compiled`` flag and the SEGMENT_* events
+              **({"compilable": True}
+                 if compile_on and n.config.get("compile") else {})}
              for n in g.nodes.values()]
     edges = [{"src": e.src, "dst": e.dst, "type": e.edge_type.value}
              for e in g.edges]

@@ -169,6 +169,9 @@ class SlidingAggregate(Operator):
     # ------------------------------------------------------------------
 
     def process_batch(self, batch, ctx, collector, input_index=0):
+        # NOTE: insert_arrays below is this method's compiled-segment twin;
+        # any change to the drain/late-boundary/update/bin-bookkeeping
+        # sequence here must be mirrored there
         if self._bin_pending or self._wm_queue:
             self._drain(collector)
         if self.lane_key_fields is None:
@@ -206,6 +209,45 @@ class SlidingAggregate(Operator):
                 vals.append(np.ones(n, dtype=dt))
             else:
                 vals.append(np.asarray(eval_expr(inp, batch.columns, n)).astype(dt))
+        self._aggregator().update(hashes, rel, vals)
+        if self.backend != "numpy":  # numpy path never reads the set
+            self.open_bins.update(np.unique(rel).tolist())
+        lo, hi = int(rel.min()), int(rel.max())
+        self.min_bin = lo if self.min_bin is None else min(self.min_bin, lo)
+        self.max_bin = hi if self.max_bin is None else max(self.max_bin, hi)
+        if self.next_window is None:
+            self.next_window = self.min_bin - self.nb + 1
+
+    def insert_arrays(self, hashes, bins_abs, vals, collector) -> None:
+        """Compiled-segment twin of process_batch (engine/segment.py, same
+        contract as TumblingAggregate.insert_arrays): apply this member's
+        mutable-state logic — drain, late filter, aggregator update, bin
+        bookkeeping — to prefix-traced arrays. State lives here either way,
+        so checkpoints and the late boundary are byte-identical. Only
+        reached when the compile gate proved there are no host key
+        dictionary fields and no collect accumulators."""
+        if self._bin_pending or self._wm_queue:
+            self._drain(collector)
+        if len(hashes) == 0:
+            return
+        if self.base_bin is None:
+            self.base_bin = int(bins_abs.min())
+        rel = bins_abs - self.base_bin
+        late_before = self.next_window
+        if self._late_before is not None:
+            late_before = (self._late_before if late_before is None
+                           else max(late_before, self._late_before))
+        if late_before is not None:
+            late = rel < late_before
+            if late.any():
+                self.late_rows += int(late.sum())
+                if late.all():
+                    return
+                keep = ~late
+                rel = rel[keep]
+                hashes = hashes[keep]
+                vals = [v[keep] for v in vals]
+        rel = rel.astype(np.int32)
         self._aggregator().update(hashes, rel, vals)
         if self.backend != "numpy":  # numpy path never reads the set
             self.open_bins.update(np.unique(rel).tolist())

@@ -392,6 +392,10 @@ class TumblingAggregate(Operator):
     # ------------------------------------------------------------------
 
     def process_batch(self, batch, ctx, collector, input_index=0):
+        # NOTE: insert_arrays below is this method's compiled-segment twin;
+        # any change to the drain/base-bin/late-filter/update sequence here
+        # must be mirrored there (the first-batch verification only covers
+        # the traced PREFIX outputs, not this state logic)
         self._batch_seq += 1
         if self._pending:
             self._drain_pending(collector)
@@ -425,6 +429,37 @@ class TumblingAggregate(Operator):
                 vals.append(np.ones(n, dtype=dt))
             else:
                 vals.append(np.asarray(eval_expr(inp, batch.columns, n)).astype(dt))
+        self._aggregator().update(hashes, rel, vals)
+        self.open_bins.update(np.unique(rel).tolist())
+
+    def insert_arrays(self, hashes, bins_abs, vals, collector) -> None:
+        """Compiled-segment twin of process_batch (engine/segment.py): the
+        traced prefix already evaluated the routing hashes, absolute bins,
+        and accumulator inputs; this applies the member's mutable-state
+        logic — pending-close drain, late-data filter, aggregator update —
+        exactly as process_batch does. State lives HERE either way, so
+        checkpoints and the late boundary are byte-identical across the
+        compiled and interpreted paths. Only reached when the compile gate
+        proved there are no host key dictionary fields and no collect
+        accumulators."""
+        self._batch_seq += 1
+        if self._pending:
+            self._drain_pending(collector)
+        if len(hashes) == 0:
+            return
+        if self.base_bin is None:
+            self.base_bin = int(bins_abs.min())
+        rel = (bins_abs - self.base_bin).astype(np.int32)
+        if self.emitted_before_rel is not None:
+            late = rel < self.emitted_before_rel
+            if late.any():
+                self.late_rows += int(late.sum())
+                if late.all():
+                    return
+                keep = ~late
+                rel = rel[keep]
+                hashes = hashes[keep]
+                vals = [v[keep] for v in vals]
         self._aggregator().update(hashes, rel, vals)
         self.open_bins.update(np.unique(rel).tolist())
 

@@ -526,6 +526,54 @@ def test_lr110_logger_in_function():
     assert "LR110" not in ids_of(lint_source(waived, "arroyo_tpu/controller/x.py"))
 
 
+def test_lr111_jit_in_hot_path():
+    bad = (
+        "import jax\n"
+        "class Op:\n"
+        "    def process_batch(self, batch, ctx, collector, input_index=0):\n"
+        "        fn = jax.jit(lambda x: x + 1)\n"
+        "        collector.collect(fn(batch))\n"
+    )
+    # per-batch jit in any operator hot-path method is the retrace bug
+    for rel in ("arroyo_tpu/operators/x.py", "arroyo_tpu/windows/x.py",
+                "arroyo_tpu/ops/x.py"):
+        assert "LR111" in ids_of(lint_source(bad, rel)), rel
+    for hot in ("handle_watermark", "handle_tick"):
+        variant = bad.replace("process_batch", hot)
+        assert "LR111" in ids_of(
+            lint_source(variant, "arroyo_tpu/operators/x.py")), hot
+    # bare jit()/pjit() names count too (from-imports)
+    frm = (
+        "from jax import jit\n"
+        "class Op:\n"
+        "    def process_batch(self, b, ctx, collector, input_index=0):\n"
+        "        jit(lambda x: x)(b)\n"
+    )
+    assert "LR111" in ids_of(lint_source(frm, "arroyo_tpu/windows/x.py"))
+    # jit in a once-per-config builder (not a hot-path method) is the
+    # sanctioned pattern — slot_agg's _build_slot_jax shape
+    good = (
+        "import jax\n"
+        "def _build(cfg):\n"
+        "    return jax.jit(lambda x: x + 1)\n"
+        "class Op:\n"
+        "    def process_batch(self, b, ctx, collector, input_index=0):\n"
+        "        self._fn(b)\n"
+    )
+    assert "LR111" not in ids_of(lint_source(good, "arroyo_tpu/ops/x.py"))
+    # outside operator/window/ops dirs the segment compiler owns jit use
+    assert "LR111" not in ids_of(lint_source(bad, "arroyo_tpu/engine/x.py"))
+    waived = bad.replace(
+        "fn = jax.jit(lambda x: x + 1)",
+        "fn = jax.jit(lambda x: x + 1)  # lint: waive LR111 — test fixture")
+    assert "LR111" not in ids_of(lint_source(waived, "arroyo_tpu/operators/x.py"))
+    # the repo itself must hold the invariant
+    from arroyo_tpu.analysis import lint_paths
+
+    assert not [d for d in lint_paths(["arroyo_tpu"])
+                if d.rule_id == "LR111"]
+
+
 def test_waivers():
     bad = (
         "def f():\n"
