@@ -19,6 +19,16 @@ Two engines share one diagnostic model (``diagnostics.Diagnostic``):
   set/dict order. Runs inside the same ``lint`` sweep; its static
   coverage verdict is cross-checked at runtime by
   tests/test_state_audit.py.
+- **Trace-safety auditor** (``trace_audit``, LR3xx + plan pass AR009): a
+  call-closure walk from every ``jax.jit`` root and ``eval_jnp`` twin
+  proving trace-reachable code is pure (no host syncs, no Python control
+  flow on traced values, no member-state access), shape-stable, and
+  numerically parity-safe (segment.py's allowlist vs expr.py's twin
+  implementations, dual-path dtype semantics, the x64 pin). AR009
+  propagates schema dtypes through every compile-marked segment at plan
+  time and rejects dtype-divergent pipelines before they run; its static
+  jnp dtype model and the allowlist's bit-exactness are cross-checked at
+  runtime by tests/test_trace_audit.py.
 
 ``lint --json`` / ``check --json`` emit the diagnostics as a JSON array
 (rule, severity, site, message, fix hint) with unchanged exit codes.
@@ -38,6 +48,7 @@ from .diagnostics import (  # noqa: F401
     finish,
     render_json,
     render_report,
+    render_sarif,
     worst,
 )
 from .plan_passes import PLAN_PASSES, PassContext, analyze_graph  # noqa: F401
@@ -49,6 +60,12 @@ from .state_audit import (  # noqa: F401
     audit_package,
     audit_source,
     coverage_for_class,
+)
+from .trace_audit import RULES as TRACE_RULES  # noqa: F401
+from .trace_audit import (  # noqa: F401
+    audit_trace_modules,
+    audit_trace_source,
+    audit_trace_sources,
 )
 
 

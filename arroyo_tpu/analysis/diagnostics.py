@@ -91,6 +91,56 @@ def render_json(diags: list[Diagnostic]) -> str:
     return json.dumps([d.to_dict() for d in diags], indent=2)
 
 
+_SITE_RE = None  # compiled lazily; module stays import-light
+
+
+def render_sarif(diags: list[Diagnostic]) -> str:
+    """Deterministic SARIF 2.1.0 document for ``lint --sarif`` / ``check
+    --sarif``: CI annotates findings inline on PRs from this without any
+    site-string scraping. ``path:line`` sites become physical locations;
+    plan-graph sites (node ids, ``src -> dst`` edges) become logical
+    locations. Severity maps ERROR->error, WARNING->warning, INFO->note;
+    exit codes are owned by the CLI and unchanged by the format."""
+    import json
+    import re
+
+    global _SITE_RE
+    if _SITE_RE is None:
+        _SITE_RE = re.compile(r"^(?P<path>[^\s:]+\.(?:py|sql)):(?P<line>\d+)$")
+    level = {Severity.ERROR: "error", Severity.WARNING: "warning",
+             Severity.INFO: "note"}
+    results = []
+    for d in diags:
+        res = {
+            "ruleId": d.rule_id,
+            "level": level[d.severity],
+            "message": {"text": d.message + (f"\nhint: {d.hint}" if d.hint
+                                             else "")},
+        }
+        m = _SITE_RE.match(d.site)
+        if m:
+            res["locations"] = [{"physicalLocation": {
+                "artifactLocation": {"uri": m.group("path")},
+                "region": {"startLine": int(m.group("line"))},
+            }}]
+        else:
+            res["locations"] = [{"logicalLocations": [
+                {"fullyQualifiedName": d.site}]}]
+        results.append(res)
+    rules = [{"id": rid} for rid in sorted({d.rule_id for d in diags})]
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                    "master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "arroyo-tpu-analysis",
+                                "rules": rules}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
+
+
 def render_report(diags: list[Diagnostic]) -> str:
     if not diags:
         return "no findings"

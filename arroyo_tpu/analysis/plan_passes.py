@@ -30,6 +30,13 @@ Rule catalog (README "Static analysis" section documents each with examples):
                                     operator's configured TTL (a mismatch
                                     silently widens or narrows the state
                                     restore window)
+    AR009 segment-compilability     (trace_audit.pass_segment_compile)
+                                    dual-path dtype parity of plan-marked-
+                                    compilable segments: reject when the
+                                    traced program would compute in a
+                                    different dtype than the interpreted
+                                    path; surface each unmarked chain's
+                                    ``not compilable: <reason>`` as INFO
 """
 
 from __future__ import annotations
@@ -418,6 +425,10 @@ def pass_table_specs(ctx: PassContext) -> None:
             )
 
 
+# AR009 lives with the trace-safety auditor (dual-path dtype model shared
+# with the LR3xx rules) but runs as an ordinary plan pass
+from .trace_audit import pass_segment_compile  # noqa: E402
+
 PLAN_PASSES: tuple[tuple[str, Callable[[PassContext], None]], ...] = (
     ("edge-schema-consistency", pass_edge_schema),
     ("watermark-safety", pass_watermark_safety),
@@ -426,6 +437,7 @@ PLAN_PASSES: tuple[tuple[str, Callable[[PassContext], None]], ...] = (
     ("barrier-reachability", pass_barrier_reachability),
     ("shuffle-key-consistency", pass_shuffle_keys),
     ("table-spec-consistency", pass_table_specs),
+    ("segment-compilability", pass_segment_compile),
 )
 
 

@@ -77,6 +77,10 @@ The LR2xx series (replay-soundness audit: checkpoint-coverage of operator
 state, commit-gated side effects, checkpoint/restore table symmetry,
 ordered emission) lives in ``state_audit.py`` and runs as part of every
 ``lint_paths`` sweep that touches operators/, windows/, or connectors/.
+The LR3xx series (trace-safety audit: purity/host-sync, shape stability,
+allowlist drift, and dual-path dtype parity of segment-compiled and device
+code) lives in ``trace_audit.py`` and runs as a whole-program pass over
+every ``lint_paths`` sweep.
 
 Waivers: append ``# lint: waive LR1xx — justification`` on the flagged
 line (or the line above). A waiver with no justification text does not
@@ -103,10 +107,27 @@ class ModuleInfo:
     relpath: str  # forward-slash path relative to the repo/package root
     tree: ast.AST
     comments: dict[int, str] = field(default_factory=dict)  # line -> text
+    # local name -> canonical dotted origin, mined from module imports
+    # (``import jax.numpy as whatever`` -> {"whatever": "jax.numpy"},
+    # ``from jax import jit as J`` -> {"J": "jax.jit"}), so no rule keyed
+    # on a module/function name can be dodged by an import alias
+    aliases: dict[str, str] = field(default_factory=dict)
 
     def in_dirs(self, *dirs: str) -> bool:
         parts = self.relpath.split("/")
         return any(d in parts for d in dirs)
+
+    def canonical(self, dotted: str) -> str:
+        """Rewrite the leading segment of a dotted name through the
+        module's import aliases (``whatever.asarray`` -> ``jax.numpy.
+        asarray``). Names with no alias pass through unchanged."""
+        if not dotted:
+            return dotted
+        head, _, rest = dotted.partition(".")
+        root = self.aliases.get(head)
+        if root is None:
+            return dotted
+        return f"{root}.{rest}" if rest else root
 
     def waiver(self, line: int, rule_id: str) -> Optional[str]:
         """Justification text if a valid waiver covers (line, rule)."""
@@ -117,8 +138,28 @@ class ModuleInfo:
         return None
 
 
+def _mine_aliases(tree: ast.AST) -> dict[str, str]:
+    """Module-wide import alias map (absolute imports only: relative
+    imports bind package-internal names the rules never key on)."""
+    out: dict[str, str] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            for a in n.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:  # `import jax.numpy` binds the root name `jax`
+                    root = a.name.split(".")[0]
+                    out.setdefault(root, root)
+        elif isinstance(n, ast.ImportFrom) and n.module and not n.level:
+            for a in n.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = f"{n.module}.{a.name}"
+    return out
+
+
 def _parse(source: str, relpath: str) -> ModuleInfo:
     info = ModuleInfo(relpath.replace(os.sep, "/"), ast.parse(source))
+    info.aliases = _mine_aliases(info.tree)
     try:
         for tok in tokenize.generate_tokens(io.StringIO(source).readline):
             if tok.type == tokenize.COMMENT:
@@ -204,8 +245,14 @@ def rule_lr101(mod: ModuleInfo) -> Iterable[Finding]:
         if not isinstance(node, ast.ExceptHandler):
             continue
         for n in ast.walk(node):
-            if not (isinstance(n, ast.Call) and _call_name(n) == "sleep"
-                    and _receiver_name(n) in ("time", "_time")):
+            if not isinstance(n, ast.Call):
+                continue
+            # canonical first: `from time import sleep as zz; zz(...)`
+            # must resolve — the bare-name dodge the alias map exists for
+            is_sleep = mod.canonical(_dotted(n.func)) == "time.sleep" or (
+                _call_name(n) == "sleep"
+                and _receiver_name(n) in ("time", "_time"))
+            if not is_sleep:
                 continue
             from_shared = any(
                 isinstance(a, ast.Call) and _call_name(a) == "next_delay"
@@ -259,8 +306,8 @@ def rule_lr103(mod: ModuleInfo) -> Iterable[Finding]:
     for n in ast.walk(mod.tree):
         if not isinstance(n, ast.Call):
             continue
-        dn = _dotted(n.func)
-        if dn.startswith(("random.", "np.random.", "numpy.random.")) and \
+        dn = mod.canonical(_dotted(n.func))
+        if dn.startswith(("random.", "numpy.random.")) and \
                 dn.rsplit(".", 1)[-1] in _RANDOM_FNS:
             yield (n.lineno,
                    f"unseeded {dn}() in operator/engine code: output differs "
@@ -294,7 +341,8 @@ def rule_lr104(mod: ModuleInfo) -> Iterable[Finding]:
                 produces_device = any(
                     isinstance(c, ast.Call) and (
                         _call_name(c) == "eval_jnp"
-                        or _dotted(c.func).startswith(("jnp.", "jax."))
+                        or mod.canonical(_dotted(c.func)).startswith(
+                            ("jax.", "jnp."))
                     )
                     for c in ast.walk(n.value)
                 )
@@ -308,9 +356,9 @@ def rule_lr104(mod: ModuleInfo) -> Iterable[Finding]:
             arg0 = n.args[0]
             if not (isinstance(arg0, ast.Name) and arg0.id in device_names):
                 continue
-            dn = _dotted(n.func)
-            if dn == "float" or dn in ("np.asarray", "np.array", "numpy.asarray",
-                                       "numpy.array"):
+            dn = mod.canonical(_dotted(n.func))
+            if dn == "float" or dn in ("numpy.asarray", "numpy.array",
+                                       "np.asarray", "np.array"):
                 yield (n.lineno,
                        f"{dn}() on a device value inside {fn.name}: forces a "
                        "blocking device->host transfer in the per-batch hot "
@@ -481,11 +529,17 @@ def rule_lr109(mod: ModuleInfo) -> Iterable[Finding]:
     if not mod.in_dirs("operators", "windows", "state", "ops"):
         return
     for n in ast.walk(mod.tree):
-        if isinstance(n, ast.Call) \
-                and _receiver_name(n) in ("time", "_time") \
-                and _call_name(n) in _LR109_TIME_FNS:
+        if not isinstance(n, ast.Call):
+            continue
+        dn = mod.canonical(_dotted(n.func))
+        clock = (dn.startswith("time.") and
+                 dn.split(".", 1)[1] in _LR109_TIME_FNS) or \
+            (_receiver_name(n) in ("time", "_time")
+             and _call_name(n) in _LR109_TIME_FNS)
+        if clock:
             yield (n.lineno,
-                   f"{_receiver_name(n)}.{_call_name(n)}() in operator/"
+                   f"{_receiver_name(n) or dn.rsplit('.', 1)[0]}."
+                   f"{_call_name(n)}() in operator/"
                    "window/state code: self-measurement belongs in the "
                    "profiler hooks (obs/profile.py), where it lands in "
                    "arroyo_worker_self_time_seconds instead of a side "
@@ -545,7 +599,7 @@ def rule_lr111(mod: ModuleInfo) -> Iterable[Finding]:
         for n in ast.walk(fn):
             if not isinstance(n, ast.Call):
                 continue
-            dn = _dotted(n.func)
+            dn = mod.canonical(_dotted(n.func))
             if dn in _LR111_JIT_NAMES or dn.endswith((".jit", ".pjit")):
                 yield (n.lineno,
                        f"{dn}() inside {fn.name}: a per-batch jit builds a "
@@ -636,6 +690,7 @@ def lint_paths(paths: list[str], root: Optional[str] = None) -> list[Diagnostic]
     wired_sites: set[str] = set()
     saw_faults_pkg = False
     audited: list[ModuleInfo] = []
+    parsed: list[ModuleInfo] = []
     for f in files:
         rel = os.path.relpath(f, root).replace(os.sep, "/")
         with open(f) as fh:
@@ -646,6 +701,7 @@ def lint_paths(paths: list[str], root: Optional[str] = None) -> list[Diagnostic]
             diags.append(Diagnostic("LR000", Severity.ERROR, f"{rel}:{e.lineno or 0}",
                                     f"file does not parse: {e.msg}"))
             continue
+        parsed.append(mod)
         diags.extend(lint_module(mod))
         wired_sites |= _site_literals(mod.tree)
         if mod.in_dirs("operators", "windows", "connectors"):
@@ -656,6 +712,13 @@ def lint_paths(paths: list[str], root: Optional[str] = None) -> list[Diagnostic]
         from .state_audit import audit_modules
 
         diags.extend(audit_modules(audited)[0])
+    if parsed:
+        # trace-safety audit (LR3xx): a whole-program pass over the sweep —
+        # it self-selects its scope (jit roots + eval_jnp twins), so running
+        # it over every parsed module keeps `lint` the single entry point
+        from .trace_audit import audit_trace_modules
+
+        diags.extend(audit_trace_modules(parsed))
     if saw_faults_pkg:
         for site in _DECLARED_FAULT_SITES:
             if site not in wired_sites:

@@ -41,19 +41,21 @@ def _cmd_check(args) -> int:
     clean (warnings allowed unless --strict), 1 = rejected."""
     import arroyo_tpu
     from arroyo_tpu.analysis import (Severity, check_sql, render_json,
-                                     render_report)
+                                     render_report, render_sarif)
 
     arroyo_tpu._load_operators()
     with open(args.sql_file) as f:
         sql = f.read()
     pp, diags = check_sql(sql, parallelism=args.parallelism)
-    if args.json:
+    if args.sarif:
+        print(render_sarif(diags))
+    elif args.json:
         print(render_json(diags))
     elif diags:
         print(render_report(diags))
     if any(d.severity == Severity.ERROR for d in diags) or pp is None:
         return 1
-    if pp is not None and not diags and not args.json:
+    if pp is not None and not diags and not args.json and not args.sarif:
         print(f"ok: {len(pp.graph.nodes)} nodes, {len(pp.graph.edges)} edges, "
               "no findings")
     if args.strict and diags:
@@ -67,12 +69,16 @@ def _cmd_lint(args) -> int:
     machine-readable array for CI annotation). Exit 1 on any unwaived
     finding."""
     import arroyo_tpu
-    from arroyo_tpu.analysis import lint_paths, render_json, render_report
+    from arroyo_tpu.analysis import (lint_paths, render_json, render_report,
+                                     render_sarif)
 
     pkg_dir = os.path.dirname(os.path.abspath(arroyo_tpu.__file__))
     root = os.path.dirname(pkg_dir)
     paths = args.paths or [pkg_dir]
     diags = lint_paths(paths, root=root)
+    if args.sarif:
+        print(render_sarif(diags))
+        return 1 if diags else 0
     if args.json:
         print(render_json(diags))
         return 1 if diags else 0
@@ -740,6 +746,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     kp.add_argument("--json", action="store_true",
                     help="machine-readable diagnostics (rule, severity, "
                          "site, message, hint); exit codes unchanged")
+    kp.add_argument("--sarif", action="store_true",
+                    help="SARIF 2.1.0 diagnostics for CI inline "
+                         "annotations; exit codes unchanged")
     kp.set_defaults(fn=_cmd_check)
 
     lp = sub.add_parser("lint", help="repo lint + replay-soundness audit: "
@@ -750,6 +759,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     lp.add_argument("--json", action="store_true",
                     help="machine-readable diagnostics (rule, severity, "
                          "site, message, hint); exit codes unchanged")
+    lp.add_argument("--sarif", action="store_true",
+                    help="SARIF 2.1.0 diagnostics for CI inline "
+                         "annotations; exit codes unchanged")
     lp.set_defaults(fn=_cmd_lint)
 
     cs = sub.add_parser("compile-service",

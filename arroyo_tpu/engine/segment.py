@@ -73,6 +73,19 @@ _TRACEABLE_FUNCS = {"abs", "floor", "ceil", "sqrt", "extract_epoch",
 _TRACEABLE_BINOPS = {"+", "-", "*", "/", "%", "==", "!=", "<", "<=", ">",
                      ">=", "and", "or"}
 
+# ops implemented with BOTH numpy and jnp twins in expr.py yet deliberately
+# kept out of the allowlist: their two implementations are not bit-exact
+# (libm vs XLA rounding for the transcendentals; decimal-scaled round).
+# Trace-safety rule LR303 audits the three sets against expr.py — an
+# allowlisted op with no trace builder is an ERROR, a dual-implemented op
+# in neither this set nor the allowlist is a WARN (silently uncompiled),
+# and an op in both sets is a contradiction. The allowlisted set itself is
+# proven bit-exact across the dtype matrix by the runtime parity oracle
+# (tests/test_trace_audit.py).
+_KNOWN_DIVERGENT_FUNCS = {"ln", "log10", "exp", "power", "round"}
+
+_KNOWN_DIVERGENT_BINOPS: set[str] = set()
+
 
 def expr_traceable(e: Expr) -> Optional[str]:
     """None if ``e`` evaluates identically under eval_jnp, else the reason
@@ -127,12 +140,8 @@ def _referenced(exprs) -> set[str]:
 _WINDOW_OPS = (OpName.TUMBLING_AGGREGATE.value, OpName.SLIDING_AGGREGATE.value)
 
 
-def segment_marking(members: list[tuple[str, dict]]) -> Optional[dict]:
-    """Static compilability of a chained run: the maximal traceable PREFIX
-    of the member list, judged by op kind and expression shape (runtime
-    still gates on actual column dtypes and verifies the first batch).
-    Returns ``{"prefix": k, "insert": bool, "stop": reason}`` when the
-    prefix is worth compiling (>= 2 members), else None."""
+def _scan_members(members: list[tuple[str, dict]]) -> tuple[int, bool, str]:
+    """(traceable prefix length, ends in a window insert, stop reason)."""
     k = 0
     insert = False
     stop = "end of chain"
@@ -146,9 +155,35 @@ def segment_marking(members: list[tuple[str, dict]]) -> Optional[dict]:
             insert = True
             stop = "window insert terminates the traced prefix"
             break
+    return k, insert, stop
+
+
+def segment_marking(members: list[tuple[str, dict]]) -> Optional[dict]:
+    """Static compilability of a chained run: the maximal traceable PREFIX
+    of the member list, judged by op kind and expression shape (runtime
+    still gates on actual column dtypes and verifies the first batch).
+    Returns ``{"prefix": k, "insert": bool, "stop": reason}`` when the
+    prefix is worth compiling (>= 2 members), else None."""
+    k, insert, stop = _scan_members(members)
     if k < 2:
         return None
     return {"prefix": k, "insert": insert, "stop": stop}
+
+
+def segment_reject_reason(members: list[tuple[str, dict]]) -> Optional[str]:
+    """Human-readable ``not compilable: <reason>`` for a chained run that
+    ``segment_marking`` declined to mark, or None when it IS marked.
+
+    Attached to the chained node's config at plan time (optimizer.
+    chain_graph) and surfaced by ``check`` (AR009 INFO), ``explain``,
+    ``top``, and the executed-graph view — so an uncompiled segment is a
+    plan-time explained fact, not an unexplained runtime fallback."""
+    k, _insert, stop = _scan_members(members)
+    if k >= 2:
+        return None
+    # the stop reason leads: narrow renderers (`top` truncates) must show
+    # the actionable part, not a boilerplate prefix
+    return f"not compilable: {stop} (traceable prefix {k} < 2)"
 
 
 def _member_traceable(op: str, cfg: dict, first: bool = False) -> Optional[str]:
@@ -449,10 +484,21 @@ def _trace_fn(plan: _SegmentPlan) -> Callable:
     import jax
     import jax.numpy as jnp
 
+    # pin 64-bit jax semantics BEFORE the first trace: without it a chain
+    # that never touches a device kernel (value/key/wm-only — nothing has
+    # imported arroyo_tpu.ops) traces under default 32-bit jax, int64
+    # inputs downcast, and every first-batch verification fails into a
+    # permanent unexplained fallback (trace-safety rule LR304)
+    from ..ops import require_x64
+
+    require_x64()
+
     def fn(n, *arrays):
         p = arrays[0].shape[0]
         cols: dict[str, Any] = dict(zip(plan.traced_in, arrays))
-        base = jnp.arange(p) < n  # padding-tail invalidity
+        # dtype pinned: bare arange would follow the jax_enable_x64 flag
+        # (int32 by default) while the numpy twin is fixed 64-bit (LR304)
+        base = jnp.arange(p, dtype=jnp.int64) < n  # padding-tail invalidity
         valid = None  # narrows at each filter; None = all real rows valid
         aux: list[Any] = []
         outs: dict[str, Any] = {}
@@ -840,6 +886,9 @@ class SegmentRunner:
             if self._small_streak >= 8:
                 self._fallback = True  # cost latch; state paths unaffected
                 self.metrics.segment_compiled = False
+                self.metrics.segment_reason = (
+                    "hoisted-filter survivors stayed under "
+                    "segment.compile.min-rows (cost latch)")
             self.chain.process_batch(batch, ctx, collector,
                                      input_index=input_index)
             return
@@ -975,6 +1024,7 @@ class SegmentRunner:
     def _mark_fallback(self, reason: str) -> None:
         self._fallback = True
         self.metrics.segment_compiled = False
+        self.metrics.segment_reason = reason
         self._event(
             "WARN", "SEGMENT_FALLBACK",
             f"segment {self.chain.name()} fell back to the interpreted "
@@ -1046,5 +1096,10 @@ def runner_for(operator, ctx, metrics) -> Optional[SegmentRunner]:
         return None
     marking = operator.compile_marking
     if not marking:
+        # plan-time reject (optimizer.chain_graph): record the reason so
+        # `top`/`explain` show "not compiled: ..." instead of nothing
+        reason = getattr(operator, "compile_reject", None)
+        if reason:
+            metrics.segment_reason = reason
         return None
     return SegmentRunner(operator, ctx, metrics, marking)

@@ -126,6 +126,21 @@ def _div_jnp(a, b):
     return jnp.divide(a, b)
 
 
+def _mod_jnp(a, b):
+    """Modulo whose traced result is byte-exact with np.mod: when the
+    remainder is an exact zero, numpy gives it the DIVISOR's sign while
+    XLA keeps the dividend's — patch the measure-zero cells (the parity
+    oracle in tests/test_trace_audit.py caught this; nonzero results and
+    NaN propagation are untouched)."""
+    import jax.numpy as jnp
+
+    r = jnp.mod(a, b)
+    if jnp.issubdtype(jnp.asarray(r).dtype, jnp.floating):
+        r = jnp.where(r == 0,
+                      jnp.copysign(jnp.zeros_like(r), jnp.asarray(b)), r)
+    return r
+
+
 @dataclass(frozen=True)
 class BinOp(Expr):
     op: str
@@ -168,7 +183,7 @@ class BinOp(Expr):
         return {
             "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
             "/": _div_jnp,
-            "%": jnp.mod,
+            "%": _mod_jnp,
             "==": lambda a, b: a == b, "!=": lambda a, b: a != b,
             "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
             ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
@@ -441,14 +456,28 @@ class Func(Expr):
     def eval_jnp(self, cols):
         import jax.numpy as jnp
 
+        def as_np_float(x):
+            # numpy promotes integer inputs of floor/ceil/sqrt to float64;
+            # jnp leaves floor/ceil of ints as ints and computes sqrt(int32)
+            # in float32 — promote explicitly so the traced twin matches the
+            # interpreted dtype bit for bit (bool stays divergent: numpy
+            # computes in float16, XLA has no exact twin — AR009 rejects
+            # float functions over bool at plan time for exactly this)
+            x = jnp.asarray(x)
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                return x.astype(jnp.float64)
+            return x
+
         a = [arg.eval_jnp(cols) for arg in self.args]
         name = self.name
         table = {
-            "abs": jnp.abs, "floor": jnp.floor, "ceil": jnp.ceil, "sqrt": jnp.sqrt,
-            "ln": jnp.log, "log10": jnp.log10, "exp": jnp.exp,
+            "abs": jnp.abs, "ln": jnp.log, "log10": jnp.log10, "exp": jnp.exp,
         }
         if name in table:
             return table[name](a[0])
+        if name in ("floor", "ceil", "sqrt"):
+            fn = {"floor": jnp.floor, "ceil": jnp.ceil, "sqrt": jnp.sqrt}[name]
+            return fn(as_np_float(a[0]))
         if name == "round":
             return jnp.round(a[0], int(self.args[1].value) if len(a) > 1 else 0)
         if name == "power":
@@ -457,6 +486,8 @@ class Func(Expr):
             return a[0] // 1_000_000
         if name == "date_trunc_micros":
             return (a[1] // a[0]) * a[0]
+        if name == "to_timestamp_micros":
+            return jnp.asarray(a[0]).astype(jnp.int64)
         raise NotImplementedError(f"device scalar function {name}")
 
     def columns(self):

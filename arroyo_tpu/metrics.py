@@ -112,7 +112,8 @@ class TaskMetrics:
                  "queue_rem", "emit_batch_rows", "queue_transit",
                  "sink_event_latency", "watermark_micros", "self_time",
                  "self_cpu", "late_rows", "state_rows", "state_bytes",
-                 "sketch", "started_monotonic", "segment_compiled")
+                 "sketch", "started_monotonic", "segment_compiled",
+                 "segment_reason")
 
     def __init__(self, job_id: str, node_id: str, subtask: int):
         self.job_id = job_id
@@ -150,6 +151,11 @@ class TaskMetrics:
         # fallback, None for operators the compiler never considered —
         # `top` and `explain` render the [compiled] marker from this
         self.segment_compiled: Optional[bool] = None
+        # why the segment is NOT compiled: the plan-time reject reason
+        # (optimizer.chain_graph "not compilable: ...") or the runtime
+        # fallback reason (SEGMENT_FALLBACK) — `top` and `explain` render
+        # it next to the [compiled] marker
+        self.segment_reason: Optional[str] = None
 
     def histogram(self, name: str) -> Histogram:
         # explicit mapping: an unknown/typoed name must fail loudly at the
@@ -442,6 +448,8 @@ class MetricsRegistry:
             }
             if t.segment_compiled is not None:
                 entry["segment_compiled"] = t.segment_compiled
+            if t.segment_reason is not None:
+                entry["segment_reason"] = t.segment_reason
             if t.sketch is not None and t.sketch.total:
                 # fixed-width hex: merges deterministically (merge_topk) and
                 # survives JSON without 64-bit precision loss
@@ -484,6 +492,10 @@ def _op_aggregate(per_subtask: dict[str, dict]) -> dict:
     }
     if any(s.get("segment_compiled") for s in per_subtask.values()):
         out["segment_compiled"] = True
+    reasons = sorted({s["segment_reason"] for s in per_subtask.values()
+                      if s.get("segment_reason")})
+    if reasons:
+        out["segment_reason"] = reasons[0]
     process_s = (out.get("self_time") or {}).get("process")
     recv = out.get("arroyo_worker_messages_recv", 0)
     if process_s and recv:

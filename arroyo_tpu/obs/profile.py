@@ -232,6 +232,8 @@ def job_profile(metrics: Optional[dict]) -> dict:
         }
         if m.get("segment_compiled"):
             out[op]["segment_compiled"] = True
+        if m.get("segment_reason"):
+            out[op]["segment_reason"] = m["segment_reason"]
     return out
 
 
@@ -300,6 +302,10 @@ def _annotations(prof: dict) -> list[str]:
         # whole-segment compilation: this row's self-time is ONE jitted
         # dispatch covering every chained member, not a per-member sum
         head = "[compiled] " + head
+    elif prof.get("segment_reason"):
+        # the plan-time reject or runtime fallback reason: the segment is
+        # interpreted, and this line says why (AR009 / SEGMENT_FALLBACK)
+        head = f"[not compiled: {prof['segment_reason']}] " + head
     head += (f"   in {_fmt_rate(prof.get('rows_in_per_sec'))}"
              f"   out {_fmt_rate(prof.get('rows_out_per_sec'))}")
     st = prof.get("self_time") or {}
@@ -368,6 +374,10 @@ def render_explain(nodes: list[dict], edges: list[dict], profile: dict,
         if prof:
             for a in _annotations(prof):
                 lines.append(f"{pad}     {a}")
+        elif n.get("not_compilable"):
+            # no runtime profile yet: the plan-time verdict still explains
+            # why this chained run will never compile
+            lines.append(f"{pad}     [{n['not_compilable']}]")
         for src in inputs.get(nid, []):
             emit(src, depth + 1)
 

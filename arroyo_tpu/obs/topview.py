@@ -58,6 +58,15 @@ def render(job: dict, metrics: Optional[dict],
     if not metrics:
         return head + "\n  (no metrics snapshot yet)"
     rows: list[tuple[str, ...]] = []
+
+    def not_compiled(m: dict) -> str:
+        # the stored reason may carry the plan-reject boilerplate prefix;
+        # strip it so the truncated cell keeps the actionable part
+        reason = m["segment_reason"]
+        if reason.startswith("not compilable: "):
+            reason = reason[len("not compilable: "):]
+        return f" [not compiled: {reason[:48]}]"
+
     for op in sorted(metrics):
         m = metrics[op]
         if not isinstance(m, dict):
@@ -74,8 +83,12 @@ def render(job: dict, metrics: Optional[dict],
                  if hot.get("key") else "-")
         rows.append((
             # whole-segment compilation: this chained operator's batches run
-            # as one jitted dispatch (its busy% is not a per-member sum)
-            op + (" [compiled]" if m.get("segment_compiled") else ""),
+            # as one jitted dispatch (its busy% is not a per-member sum);
+            # an uncompiled segment names its plan-time reject or runtime
+            # fallback reason instead (truncated to keep the table narrow)
+            op + (" [compiled]" if m.get("segment_compiled")
+                  else not_compiled(m)
+                  if m.get("segment_reason") else ""),
             str(m.get("subtasks", len(m.get("per_subtask", {})) or 1)),
             _fmt_rate(m.get("messages_recv_per_sec")),
             _fmt_rate(m.get("messages_per_sec")),

@@ -88,6 +88,10 @@ class ChainedOperator(Operator):
         # off these and traces the marked prefix into one jitted call
         self.cfg_members: list = list(cfg["members"])
         self.compile_marking: Optional[dict] = cfg.get("compile")
+        # plan-time "not compilable: <reason>" (optimizer.chain_graph):
+        # runner_for copies it into the task metrics so top/explain can
+        # render the reject next to the [compiled] marker
+        self.compile_reject: Optional[str] = cfg.get("compile_reject")
         self._ctxs: Optional[list[OperatorContext]] = None
         self._cols = None
         # only members that declared a tick interval get ticked: the chain

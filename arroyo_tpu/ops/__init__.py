@@ -9,6 +9,20 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
+
+def require_x64() -> None:
+    """Idempotent pin for trace entry points living OUTSIDE this package.
+
+    Importing ``arroyo_tpu.ops`` pins x64 as a side effect, but a module
+    like ``engine/segment.py`` that jits traced code without ever touching
+    a device kernel (a value/key/watermark-only chain) would otherwise
+    trace under default 32-bit jax semantics: int64 inputs silently
+    downcast, the uint64 routing hash truncates, and the first-batch
+    verification fails into a permanent (and unexplained) interpreted
+    fallback. Trace-safety rule LR304 requires every jit-root module to
+    reach this pin before tracing."""
+    jax.config.update("jax_enable_x64", True)
+
 from .aggregate import (  # noqa: F401,E402
     AGG_KINDS,
     DeviceHashAggregator,

@@ -81,11 +81,17 @@ def chain_graph(g: Graph) -> Graph:
         # expression shapes. The runtime still gates on real column dtypes
         # and verifies the first batch — this marking only says "worth
         # attempting", so an unmarked chain never pays a compile probe
-        from .engine.segment import segment_marking
+        from .engine.segment import segment_marking, segment_reject_reason
 
         marking = segment_marking(members)
         if marking is not None:
             fused_cfg[fid]["compile"] = marking
+        else:
+            # explain WHY at plan time: `check` (AR009 INFO), `explain`,
+            # `top`, and the executed-graph view all surface this string,
+            # so an uncompiled segment stops being an unexplained runtime
+            # event
+            fused_cfg[fid]["compile_reject"] = segment_reject_reason(members)
 
     out = Graph()
     for nid, node in g.nodes.items():

@@ -1421,7 +1421,12 @@ def executed_graph_view(sql: str, parallelism: int = 1,
               # (compiled vs fell back) rides the profile's
               # ``segment_compiled`` flag and the SEGMENT_* events
               **({"compilable": True}
-                 if compile_on and n.config.get("compile") else {})}
+                 if compile_on and n.config.get("compile") else {}),
+              # the plan-time reject reason (optimizer.chain_graph /
+              # AR009): consumers render "why is my segment not compiled"
+              # without waiting for a runtime fallback event
+              **({"not_compilable": n.config["compile_reject"]}
+                 if compile_on and n.config.get("compile_reject") else {})}
              for n in g.nodes.values()]
     edges = [{"src": e.src, "dst": e.dst, "type": e.edge_type.value}
              for e in g.edges]

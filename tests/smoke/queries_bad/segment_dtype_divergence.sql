@@ -1,0 +1,29 @@
+-- reject: AR009
+-- Dual-path dtype divergence in a compile-marked segment: BIGINT * REAL
+-- computes float64 on the interpreted (numpy) path but float32 under the
+-- traced (jax x64) path — the one corner where the jax promotion lattice
+-- departs from numpy. The byte-exactness contract cannot hold, so AR009
+-- rejects the pipeline at plan time instead of letting the first-batch
+-- verification discover the divergence per (segment, schema) at runtime.
+CREATE TABLE src (
+  a BIGINT NOT NULL,
+  b REAL NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source'
+);
+
+CREATE TABLE sink (
+  x DOUBLE
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+
+INSERT INTO sink
+SELECT a * b
+FROM src;

@@ -1,0 +1,899 @@
+"""Trace-safety auditor (analysis/trace_audit.py): LR301–LR305 + AR009.
+
+Four layers:
+- per-rule positive and negative AST fixtures, waiver grammar, and the
+  alias-dodge fixtures for the hardened LR104/LR109/LR111 resolution;
+- the repo-audit-clean gate plus regression locks for every real finding
+  the sweep fixed (the ``to_timestamp_micros`` allowlist gap, the
+  floor/ceil/sqrt integer dtype divergence, the unpinned-x64 trace entry);
+- the runtime PARITY ORACLE: every allowlisted func/binop evaluated
+  interpreted (numpy) and freshly jitted, compared ``tobytes``-exactly
+  across the dtype matrix including NaN, ±0.0, int extremes, and empty
+  arrays — the bit-exactness claim behind ``_TRACEABLE_FUNCS`` is
+  measured, not asserted;
+- AR009: the dual-path dtype model pinned against real jitted dtypes,
+  plan-time rejection of divergent pipelines, and the ``not compilable``
+  surfacing in check/executed_graph_view/explain/top.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import arroyo_tpu
+from arroyo_tpu.analysis import (
+    Severity,
+    audit_trace_source,
+    audit_trace_sources,
+    check_sql,
+    lint_paths,
+    lint_source,
+    render_json,
+    render_sarif,
+)
+
+PKG_DIR = os.path.dirname(os.path.abspath(arroyo_tpu.__file__))
+
+# every fixture module pins x64 (imports arroyo_tpu.ops) unless the test
+# is specifically about the missing pin
+_PINNED = "from arroyo_tpu.ops import require_x64\n"
+
+
+def ids_of(diags):
+    return {d.rule_id for d in diags}
+
+
+def lines_of(diags, rule):
+    return sorted(int(d.site.rsplit(":", 1)[1]) for d in diags
+                  if d.rule_id == rule)
+
+
+# ============================================================ LR301 purity
+
+
+LR301_FIXTURE = _PINNED + '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def build():
+    def fn(x, n):
+        if x > 0:                       # if on traced
+            pass
+        v = float(x)                    # float() on traced
+        w = np.asarray(x)               # numpy on traced
+        x.item()                        # host sync
+        return v, w
+    return jax.jit(fn)
+'''
+
+
+def test_lr301_positive():
+    diags = audit_trace_source(LR301_FIXTURE, "engine/fixture.py")
+    assert ids_of(diags) == {"LR301"}
+    assert len([d for d in diags if d.rule_id == "LR301"]) == 4
+    assert all(d.severity == Severity.ERROR for d in diags)
+
+
+def test_lr301_negative_static_metadata_and_identity():
+    """Branching on static config/metadata and ``is None`` identity is
+    ordinary trace-time specialization, not impurity."""
+    src = _PINNED + '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def build(plan):
+    def fn(x, n):
+        if plan.debug:                  # static config
+            y = jnp.abs(x)
+        else:
+            y = x
+        if x is None:                   # trace-time identity
+            return None
+        if x.dtype.kind == "f":         # static metadata
+            y = y + 1
+        f = np.dtype(x.dtype)           # numpy metadata call
+        base = jnp.arange(n, dtype=jnp.int64) < n
+        return jnp.where(base, y, 0)
+    return jax.jit(fn)
+'''
+    assert audit_trace_source(src, "engine/fixture.py") == []
+
+
+def test_lr301_self_state():
+    src = _PINNED + '''
+import jax
+import jax.numpy as jnp
+
+class Op:
+    def __init__(self):
+        self.cache = None
+        self.high = 0
+    def bump(self, v):
+        self.high = v                  # mutable outside __init__
+    def eval_jnp(self, cols):
+        self.cache = cols["a"]         # write under trace
+        return jnp.abs(cols["a"]) + self.high   # read of mutable state
+'''
+    diags = audit_trace_source(src, "engine/fixture.py")
+    msgs = [d.message for d in diags if d.rule_id == "LR301"]
+    assert any("write to self.cache" in m for m in msgs)
+    assert any("mutable member state self.high" in m for m in msgs)
+
+
+def test_lr301_frozen_reads_are_clean():
+    """Reads of attributes never mutated outside __init__ (the frozen
+    Expr dataclass shape) are trace-time constants, not findings."""
+    src = _PINNED + '''
+import jax
+import jax.numpy as jnp
+
+class Expr:
+    def __init__(self, name):
+        self.name = name
+    def eval_jnp(self, cols):
+        return jnp.abs(cols[self.name])
+'''
+    assert audit_trace_source(src, "engine/fixture.py") == []
+
+
+def test_lr301_taint_through_closure_helpers():
+    """A helper in the trace closure whose return derives from jnp taints
+    its callers; a metadata-only helper does not."""
+    src = _PINNED + '''
+import jax
+import jax.numpy as jnp
+
+def _lift(v):
+    return jnp.asarray(v)
+
+def _is_float(v):
+    return v.dtype.kind == "f"
+
+def build():
+    def fn(x):
+        y = _lift(x)
+        if _is_float(x):               # host bool from metadata: clean
+            y = y + 1
+        v = float(y)                   # y is traced through _lift
+        return v
+    return jax.jit(fn)
+'''
+    diags = audit_trace_source(src, "engine/fixture.py")
+    assert [d.rule_id for d in diags] == ["LR301"]
+    assert "float()" in diags[0].message
+
+
+# ====================================================== LR302 shape stable
+
+
+def test_lr302_positive_and_negative():
+    src = _PINNED + '''
+import jax
+import jax.numpy as jnp
+
+def build():
+    def fn(x):
+        a = jnp.nonzero(x)             # no size=
+        b = jnp.where(x > 0)           # single-arg where
+        c = x[x > 0]                   # boolean mask
+        d = jnp.nonzero(x, size=8)     # pinned: fine
+        e = jnp.where(x > 0, x, 0)     # three-arg: fine
+        idx = jnp.argsort(x)
+        f = x[idx]                     # integer gather: shape-stable
+        return a, b, c, d, e, f
+    return jax.jit(fn)
+'''
+    diags = audit_trace_source(src, "engine/fixture.py")
+    assert ids_of(diags) == {"LR302"}
+    assert len(diags) == 3
+
+
+# ==================================================== LR303 allowlist drift
+
+
+SEG_FIXTURE = '''
+_TRACEABLE_FUNCS = {"abs", "ghost_fn"}
+_TRACEABLE_BINOPS = {"+"}
+_KNOWN_DIVERGENT_FUNCS = {"exp"}
+'''
+
+EXPR_FIXTURE = '''
+_NP_BINOPS = {"+": None, "*": None}
+class Func:
+    def eval_np(self, cols, n):
+        name = self.name
+        if name == "abs": return None
+        if name == "exp": return None
+        if name == "sqrt": return None
+    def eval_jnp(self, cols):
+        name = self.name
+        table = {"abs": None, "exp": None, "sqrt": None}
+        if name in table: return None
+class BinOp:
+    def eval_jnp(self, cols):
+        return {"+": None, "*": None}[self.op]
+'''
+
+
+def test_lr303_drift_both_directions():
+    diags = audit_trace_sources([
+        (SEG_FIXTURE, "arroyo_tpu/engine/segment.py"),
+        (EXPR_FIXTURE, "arroyo_tpu/expr.py"),
+    ])
+    errs = [d for d in diags if d.severity == Severity.ERROR]
+    warns = [d for d in diags if d.severity == Severity.WARNING]
+    # ghost_fn is allowlisted with neither twin: two errors (np + jnp)
+    assert sum("ghost_fn" in d.message for d in errs) == 2
+    # sqrt implemented both ways but unlisted and not known-divergent
+    assert any("'sqrt'" in d.message for d in warns)
+    # '*' implemented both ways but unlisted
+    assert any("'*'" in d.message for d in warns)
+    # exp is declared divergent: silent, not a finding
+    assert not any("'exp'" in d.message for d in diags)
+
+
+def test_lr303_contradiction():
+    seg = SEG_FIXTURE.replace('{"exp"}', '{"exp", "abs"}')
+    diags = audit_trace_sources([
+        (seg, "arroyo_tpu/engine/segment.py"),
+        (EXPR_FIXTURE, "arroyo_tpu/expr.py"),
+    ])
+    assert any("both _TRACEABLE_FUNCS and" in d.message
+               and d.severity == Severity.ERROR for d in diags)
+
+
+def test_lr303_regression_to_timestamp_micros():
+    """The real finding this PR's sweep caught: to_timestamp_micros was
+    allowlisted in _TRACEABLE_FUNCS with no eval_jnp builder — every
+    segment using it compiled, raised NotImplementedError at trace time,
+    and silently fell back. The fixture reproduces the pre-fix shape; the
+    repo-clean gate proves the live pair stays consistent."""
+    seg = SEG_FIXTURE.replace('"ghost_fn"', '"to_timestamp_micros"')
+    diags = audit_trace_sources([
+        (seg, "arroyo_tpu/engine/segment.py"),
+        (EXPR_FIXTURE, "arroyo_tpu/expr.py"),
+    ])
+    assert any("to_timestamp_micros" in d.message and "no jnp trace builder"
+               in d.message for d in diags)
+
+
+# ========================================================== LR304 dtypes
+
+
+def test_lr304_ctor_and_astype():
+    src = _PINNED + '''
+import jax
+import jax.numpy as jnp
+
+def build():
+    def fn(x, n):
+        a = jnp.arange(n)              # default dtype follows x64 flag
+        b = jnp.zeros(4)               # same
+        c = x.astype(int)              # Python builtin width
+        d = jnp.arange(n, dtype=jnp.int64)   # fine
+        e = jnp.zeros(4, jnp.float64)        # positional dtype: fine
+        f = x.astype(jnp.int64)              # fine
+        return a, b, c, d, e, f
+    return jax.jit(fn)
+'''
+    diags = audit_trace_source(src, "engine/fixture.py")
+    assert ids_of(diags) == {"LR304"}
+    assert len(diags) == 3
+
+
+def test_lr304_missing_x64_pin():
+    src = '''
+import jax
+import jax.numpy as jnp
+
+def build():
+    def fn(x):
+        return jnp.abs(x)
+    return jax.jit(fn)
+'''
+    diags = audit_trace_source(src, "engine/fixture.py")
+    assert any(d.rule_id == "LR304" and "jax_enable_x64" in d.message
+               for d in diags)
+    # the pin import satisfies the rule…
+    assert audit_trace_source(_PINNED + src, "engine/fixture.py") == []
+    # …in the package-import spelling the hint suggests too…
+    assert audit_trace_source("from arroyo_tpu import ops\n" + src,
+                              "engine/fixture.py") == []
+    # …and modules under ops/ are the pin itself
+    assert audit_trace_source(src, "ops/fixture.py") == []
+
+
+def test_x64_pinned_at_trace_entry():
+    """Regression for the real bug: a cold process importing ONLY
+    engine/segment.py (value/key/watermark chain — nothing ever imports
+    arroyo_tpu.ops) used to build its trace under default 32-bit jax,
+    downcasting every int64 input and failing verification into a
+    permanent fallback. _trace_fn must pin x64 before jitting."""
+    code = (
+        "import arroyo_tpu.engine.segment as seg\n"
+        "import jax\n"
+        "p = seg._SegmentPlan()\n"
+        "seg._trace_fn(p)\n"
+        "assert jax.config.jax_enable_x64, 'x64 not pinned at trace entry'\n"
+        "print('ok')\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "ok" in out.stdout
+
+
+# ===================================================== LR305 side effects
+
+
+def test_lr305_positive_and_negative():
+    src = _PINNED + '''
+import jax
+import jax.numpy as jnp
+import logging
+import time
+
+_log = logging.getLogger("x")
+
+def build(recorder):
+    def fn(x):
+        print("tracing")               # trace-time only
+        _log.info("batch")             # trace-time only
+        t = time.perf_counter()        # trace-time only
+        recorder.record("j", "INFO", "X")   # trace-time only
+        return jnp.abs(x)
+    jitted = jax.jit(fn)
+    print("compiled")                  # host side: fine
+    _log.info("host")                  # host side: fine
+    return jitted
+'''
+    diags = audit_trace_source(src, "engine/fixture.py")
+    assert ids_of(diags) == {"LR305"}
+    assert len(diags) == 4
+    assert all("trace time" in d.message for d in diags)
+
+
+# ================================================= waivers & determinism
+
+
+def test_waiver_grammar():
+    src = _PINNED + '''
+import jax
+import jax.numpy as jnp
+
+def build():
+    def fn(x):
+        v = float(x)  # lint: waive LR301 — proven scalar aux, synced once
+        y = x + 1
+        w = int(x)  # lint: waive LR301
+        return v, w, y
+    return jax.jit(fn)
+'''
+    diags = audit_trace_source(src, "engine/fixture.py")
+    # the justified waiver suppresses; the justification-free one does not
+    assert len(diags) == 1 and "int()" in diags[0].message
+
+
+def test_determinism_and_json_shape():
+    d1 = audit_trace_source(LR301_FIXTURE, "engine/fixture.py")
+    d2 = audit_trace_source(LR301_FIXTURE, "engine/fixture.py")
+    assert d1 == d2 and d1
+    assert [d.sort_key() for d in d1] == sorted(d.sort_key() for d in d1)
+    payload = json.loads(render_json(d1))
+    assert all(set(e) == {"rule", "severity", "site", "message", "hint"}
+               for e in payload)
+
+
+def test_sarif_shape():
+    from arroyo_tpu.analysis import Diagnostic
+
+    diags = [
+        Diagnostic("LR301", Severity.ERROR, "engine/fixture.py:12", "m", "h"),
+        Diagnostic("AR009", Severity.INFO, "a+b+c", "plan finding"),
+        Diagnostic("AR007", Severity.WARNING, "src -> dst", "edge finding"),
+    ]
+    doc = json.loads(render_sarif(diags))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "arroyo-tpu-analysis"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == \
+        {"LR301", "AR009", "AR007"}
+    res = {r["ruleId"]: r for r in run["results"]}
+    assert res["LR301"]["level"] == "error"
+    phys = res["LR301"]["locations"][0]["physicalLocation"]
+    assert phys["artifactLocation"]["uri"] == "engine/fixture.py"
+    assert phys["region"]["startLine"] == 12
+    assert res["AR009"]["level"] == "note"
+    assert res["AR009"]["locations"][0]["logicalLocations"][0][
+        "fullyQualifiedName"] == "a+b+c"
+    assert res["AR007"]["level"] == "warning"
+
+
+# ============================================== alias-dodge (LR1xx harden)
+
+
+def test_alias_dodges_do_not_evade_lint():
+    src = '''
+from jax import jit as J
+import jax.numpy as whatever
+import numpy as qq
+import time as _clock
+from time import perf_counter as pc
+
+class Thing:
+    def process_batch(self, batch, ctx, collector):
+        f = J(lambda c: c)
+        dev = whatever.abs(batch)
+        host = qq.asarray(dev)
+        t = _clock.time()
+        t2 = pc()
+'''
+    diags = lint_source(src, "arroyo_tpu/operators/fixture.py")
+    ids = ids_of(diags)
+    assert {"LR104", "LR109", "LR111"} <= ids
+    assert len([d for d in diags if d.rule_id == "LR109"]) == 2
+
+
+def test_alias_time_sleep_in_except():
+    src = '''
+import time as zz
+
+def pull():
+    try:
+        pass
+    except Exception:
+        zz.sleep(2)
+'''
+    diags = lint_source(src, "arroyo_tpu/connectors/fixture.py")
+    assert "LR101" in ids_of(diags)
+
+
+def test_alias_from_import_sleep_in_except():
+    """The bare-name dodge: ``from time import sleep as zz`` resolves
+    through the alias map even though the call has no receiver."""
+    src = '''
+from time import sleep as zz
+
+def pull():
+    try:
+        pass
+    except Exception:
+        zz(0.5)
+'''
+    diags = lint_source(src, "arroyo_tpu/connectors/fixture.py")
+    assert "LR101" in ids_of(diags)
+
+
+def test_lr303_annotated_populated_set_is_read():
+    """``_KNOWN_DIVERGENT_BINOPS: set[str] = {"**"}`` (annotated AND
+    populated) must count as declared — not silently read as empty."""
+    seg = SEG_FIXTURE + '_KNOWN_DIVERGENT_BINOPS: set = {"*"}\n'
+    ex = EXPR_FIXTURE  # implements '*' both ways, unlisted
+    diags = audit_trace_sources([
+        (seg, "arroyo_tpu/engine/segment.py"),
+        (ex, "arroyo_tpu/expr.py"),
+    ])
+    assert not any("'*'" in d.message for d in diags), diags
+
+
+def test_lr304_positional_arange_dtype():
+    src = _PINNED + '''
+import jax
+import jax.numpy as jnp
+
+def build():
+    def fn(n):
+        return jnp.arange(0, n, 1, jnp.int64)   # positional dtype: fine
+    return jax.jit(fn)
+'''
+    assert audit_trace_source(src, "engine/fixture.py") == []
+
+
+# =========================================================== repo gates
+
+
+def test_repo_trace_audit_clean():
+    """The acceptance gate: LR301–LR305 over the whole package, zero
+    unwaived findings — every real sweep finding is fixed in-code."""
+    diags = lint_paths([PKG_DIR], root=os.path.dirname(PKG_DIR))
+    lr3 = [d for d in diags if d.rule_id.startswith("LR3")]
+    assert lr3 == [], "\n".join(d.render() for d in lr3)
+
+
+def test_rules_registered():
+    from arroyo_tpu.analysis import TRACE_RULES
+
+    assert TRACE_RULES == ("LR301", "LR302", "LR303", "LR304", "LR305")
+
+
+# ====================================================== the parity oracle
+
+
+def _values(dt) -> np.ndarray:
+    dt = np.dtype(dt)
+    if dt.kind == "f":
+        return np.array([np.nan, np.inf, -np.inf, -0.0, 0.0, 1.5, -2.25,
+                         np.finfo(dt).max, np.finfo(dt).tiny], dtype=dt)
+    if dt.kind == "b":
+        return np.array([True, False], dtype=dt)
+    info = np.iinfo(dt)
+    vals = [info.min, info.max, 0, 7]
+    if dt.kind == "i":
+        vals.append(-1)
+    return np.array(vals, dtype=dt)
+
+
+def _pairs(dt_l, dt_r, nonzero_right=False):
+    a = _values(dt_l)
+    b = _values(dt_r)
+    if nonzero_right:
+        b = b[(b != 0) & np.isfinite(b.astype(np.float64, copy=False)
+                                     if np.dtype(dt_r).kind == "f" else b)]
+    l, r = np.meshgrid(a, b)
+    return l.ravel(), r.ravel()
+
+
+def _jit_expr(expr, names, arrays):
+    import jax
+
+    from arroyo_tpu.ops import require_x64
+
+    require_x64()
+
+    def fn(*arrs):
+        return expr.eval_jnp(dict(zip(names, arrs)))
+
+    return np.asarray(jax.jit(fn)(*arrays))
+
+
+def _assert_parity(expr, names, arrays, label):
+    from arroyo_tpu.expr import eval_expr
+
+    n = len(arrays[0]) if arrays else 0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        want = np.asarray(eval_expr(expr, dict(zip(names, arrays)), n))
+        got = _jit_expr(expr, names, arrays)
+    assert got.dtype == want.dtype, \
+        f"{label}: dtype {got.dtype} != {want.dtype}"
+    assert got.tobytes() == want.tobytes(), f"{label}: values differ"
+
+
+NUMERIC = ("int32", "int64", "uint64", "float32", "float64")
+# mixed pairs whose promotion CONVERGES across the two paths (int×float32
+# deliberately absent: that is the divergence AR009 rejects at plan time)
+CONVERGENT_MIXED = (("int32", "int64"), ("int64", "float64"),
+                    ("float32", "float64"), ("uint64", "float64"))
+
+
+@pytest.mark.parametrize("op", ["+", "-", "*"])
+def test_oracle_arithmetic(op):
+    from arroyo_tpu.expr import BinOp, Col
+
+    e = BinOp(op, Col("l"), Col("r"))
+    for dl, dr in [(d, d) for d in NUMERIC] + list(CONVERGENT_MIXED):
+        l, r = _pairs(dl, dr)
+        _assert_parity(e, ("l", "r"), [l, r], f"{dl} {op} {dr}")
+        _assert_parity(e, ("l", "r"),
+                       [np.empty(0, dl), np.empty(0, dr)],
+                       f"{dl} {op} {dr} empty")
+
+
+@pytest.mark.parametrize("op", ["/", "%"])
+def test_oracle_division(op):
+    """Division/modulo parity — including the float-mod signed-zero fix
+    this oracle caught (np.mod gives exact-zero remainders the DIVISOR's
+    sign, XLA the dividend's; expr._mod_jnp patches the cells). Cells
+    whose numpy result is SUBNORMAL are excluded: XLA on CPU flushes
+    denormals to zero (FTZ) and no in-repo fix exists — the documented
+    parity caveat in the README."""
+    from arroyo_tpu.expr import BinOp, Col, eval_expr
+
+    e = BinOp(op, Col("l"), Col("r"))
+    for dl, dr in [(d, d) for d in NUMERIC]:
+        l, r = _pairs(dl, dr, nonzero_right=True)
+        if op == "/" and np.dtype(dl).kind == "i":
+            # exercise the floor->trunc sign correction without the one
+            # UB cell (INT_MIN / -1 overflows differently per backend)
+            keep = ~((l == np.iinfo(dl).min) & (r == -1))
+            l, r = l[keep], r[keep]
+        if np.dtype(dl).kind == "f":
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                want = np.asarray(eval_expr(e, {"l": l, "r": r}, len(l)))
+            tiny = np.finfo(dl).tiny  # smallest NORMAL magnitude
+            subnormal = (np.abs(want) > 0) & (np.abs(want) < tiny)
+            l, r = l[~subnormal], r[~subnormal]
+        _assert_parity(e, ("l", "r"), [l, r], f"{dl} {op} {dr}")
+        _assert_parity(e, ("l", "r"),
+                       [np.empty(0, dl), np.empty(0, dr)],
+                       f"{dl} {op} {dr} empty")
+
+
+@pytest.mark.parametrize("op", ["==", "!=", "<", "<=", ">", ">="])
+def test_oracle_comparisons(op):
+    from arroyo_tpu.expr import BinOp, Col
+
+    e = BinOp(op, Col("l"), Col("r"))
+    for d in NUMERIC + ("bool",):
+        l, r = _pairs(d, d)
+        _assert_parity(e, ("l", "r"), [l, r], f"{d} {op} {d}")
+    _assert_parity(e, ("l", "r"),
+                   [np.empty(0, np.float64), np.empty(0, np.float64)],
+                   f"{op} empty")
+
+
+@pytest.mark.parametrize("op", ["and", "or"])
+def test_oracle_logic(op):
+    from arroyo_tpu.expr import BinOp, Col
+
+    e = BinOp(op, Col("l"), Col("r"))
+    l, r = _pairs("bool", "bool")
+    _assert_parity(e, ("l", "r"), [l, r], f"bool {op} bool")
+
+
+@pytest.mark.parametrize("name", ["abs", "floor", "ceil", "sqrt"])
+def test_oracle_float_funcs(name):
+    """floor/ceil/sqrt over INTEGER inputs is the regression lock for the
+    second real sweep finding: jnp left floor/ceil of ints as ints (and
+    computed sqrt(int32) in float32) where numpy promotes to float64 —
+    every such segment failed first-batch verification. eval_jnp now
+    promotes explicitly; bool inputs remain divergent and are rejected at
+    plan time by AR009 (not swept here)."""
+    from arroyo_tpu.expr import Col, Func
+
+    e = Func(name, (Col("a"),))
+    for d in NUMERIC:
+        if name == "abs" and d == "uint64":
+            pass  # np.abs(uint64) is identity; still worth sweeping
+        _assert_parity(e, ("a",), [_values(d)], f"{name}({d})")
+        _assert_parity(e, ("a",), [np.empty(0, d)], f"{name}({d}) empty")
+
+
+def test_oracle_time_funcs():
+    from arroyo_tpu.expr import Col, Func, Lit
+
+    ts = np.array([0, 1, -1, 1_234_567_890_123, -7_200_000_001,
+                   np.iinfo(np.int64).max // 2], dtype=np.int64)
+    _assert_parity(Func("extract_epoch", (Col("a"),)), ("a",), [ts],
+                   "extract_epoch(int64)")
+    _assert_parity(Func("date_trunc_micros", (Lit(60_000_000), Col("a"))),
+                   ("a",), [ts], "date_trunc_micros")
+    _assert_parity(Func("to_timestamp_micros", (Col("a"),)), ("a",), [ts],
+                   "to_timestamp_micros(int64)")
+    _assert_parity(Func("to_timestamp_micros", (Col("a"),)), ("a",),
+                   [np.array([1, 2, 3], dtype=np.int32)],
+                   "to_timestamp_micros(int32)")
+
+
+def test_oracle_composed_case_cast_neg_not():
+    """The remaining traceable node kinds, composed, across the matrix."""
+    from arroyo_tpu.expr import BinOp, Case, Cast, Col, Lit, Neg, Not
+
+    case = Case(((BinOp(">", Col("a"), Lit(0)), Col("a")),),
+                Neg(Col("a")))
+    for d in ("int32", "int64", "float32", "float64"):
+        _assert_parity(case, ("a",), [_values(d)], f"case({d})")
+    cast = Cast(BinOp("+", Col("a"), Lit(1)), "float64")
+    for d in ("int32", "int64", "float64"):
+        _assert_parity(cast, ("a",), [_values(d)], f"cast({d})")
+    notb = Not(BinOp("<", Col("a"), Lit(3)))
+    _assert_parity(notb, ("a",), [_values("int64")], "not(<)")
+
+
+# ========================================================== AR009 (plan)
+
+
+def _register_smoke():
+    smoke = os.path.join(os.path.dirname(__file__), "smoke")
+    sys.path.insert(0, smoke)
+    try:
+        import udfs  # noqa: F401
+    finally:
+        sys.path.pop(0)
+
+
+def _sql(select: str, cols: str = "a BIGINT NOT NULL, b REAL NOT NULL"):
+    return f'''
+CREATE TABLE src ({cols}) WITH (
+  connector = 'single_file', path = '/dev/null',
+  format = 'json', type = 'source'
+);
+CREATE TABLE out (x DOUBLE) WITH (
+  connector = 'single_file', path = '/tmp/ar009_out.json',
+  format = 'json', type = 'sink'
+);
+INSERT INTO out SELECT {select} FROM src;
+'''
+
+
+def test_ar009_rejects_int_float32_divergence():
+    """BIGINT * REAL: numpy widens to float64, the jax lattice stays
+    float32 — the one promotion corner where the paths split. Rejected at
+    plan time instead of failing first-batch verification at runtime."""
+    arroyo_tpu._load_operators()
+    pp, diags = check_sql(_sql("a * b"))
+    errs = [d for d in diags if d.severity == Severity.ERROR]
+    assert any(d.rule_id == "AR009" for d in errs)
+    msg = next(d.message for d in errs if d.rule_id == "AR009")
+    assert "float64" in msg and "float32" in msg
+
+
+def test_ar009_explicit_cast_is_clean():
+    arroyo_tpu._load_operators()
+    pp, diags = check_sql(_sql("a * CAST(b AS DOUBLE)"))
+    assert not any(d.rule_id == "AR009" and d.severity == Severity.ERROR
+                   for d in diags)
+    assert pp is not None
+
+
+def test_ar009_not_compilable_reason_is_surfaced():
+    """A chain the optimizer declines to mark (concat is host-only, so
+    the traceable prefix is too short) carries its ``not compilable:``
+    reason as an INFO diagnostic in check."""
+    arroyo_tpu._load_operators()
+    pp, diags = check_sql(
+        _sql("concat('p_', s)", cols="s TEXT, a BIGINT NOT NULL"))
+    infos = [d for d in diags
+             if d.rule_id == "AR009" and d.severity == Severity.INFO]
+    assert any("not compilable" in d.message for d in infos), diags
+
+
+def test_ar009_jnp_dtype_model_matches_real_jax():
+    """The static jnp dtype model behind AR009, pinned against REAL jitted
+    dtypes — if jax promotion semantics drift, this fails before the
+    model silently mis-judges pipelines."""
+    import jax
+
+    from arroyo_tpu.analysis.trace_audit import _jnp_dtype, _resolve_weak
+    from arroyo_tpu.expr import BinOp, Case, Cast, Col, Func, Lit
+
+    from arroyo_tpu.ops import require_x64
+
+    require_x64()
+
+    cases = [
+        (BinOp("*", Col("a"), Col("b")),
+         {"a": np.dtype(np.int64), "b": np.dtype(np.float32)}),
+        (BinOp("+", Col("a"), Lit(2)), {"a": np.dtype(np.int32)}),
+        (BinOp("+", Col("a"), Lit(2.5)), {"a": np.dtype(np.uint64)}),
+        (BinOp("/", Col("a"), Col("b")),
+         {"a": np.dtype(np.int64), "b": np.dtype(np.int64)}),
+        (BinOp("/", Col("a"), Lit(2.0)), {"a": np.dtype(np.int64)}),
+        (BinOp("<", Col("a"), Lit(0)), {"a": np.dtype(np.float32)}),
+        (Func("sqrt", (Col("a"),)), {"a": np.dtype(np.int32)}),
+        (Func("floor", (Col("a"),)), {"a": np.dtype(np.int64)}),
+        (Func("sqrt", (Col("a"),)), {"a": np.dtype(np.bool_)}),
+        (Func("extract_epoch", (Col("a"),)), {"a": np.dtype(np.int64)}),
+        (Cast(Col("a"), "int32"), {"a": np.dtype(np.int64)}),
+        (Case(((BinOp(">", Col("a"), Lit(0)), Col("a")),), Lit(0.5)),
+         {"a": np.dtype(np.int64)}),
+        (Func("to_timestamp_micros", (Col("a"),)),
+         {"a": np.dtype(np.int32)}),
+    ]
+    for expr, env in cases:
+        names = sorted(env)
+
+        def fn(*arrs):
+            return expr.eval_jnp(dict(zip(names, arrs)))
+
+        real = np.asarray(jax.jit(fn)(
+            *[np.ones(2, dtype=env[n]) for n in names])).dtype
+        modeled = np.dtype(_resolve_weak(_jnp_dtype(expr, env)))
+        assert modeled == real, f"{expr}: model {modeled} != real {real}"
+
+
+def test_queries_bad_fixture_registered():
+    """The catalog entry exists and carries the AR009 annotation (the
+    parametrized catalog test in test_analysis.py executes it)."""
+    p = os.path.join(os.path.dirname(__file__), "smoke", "queries_bad",
+                     "segment_dtype_divergence.sql")
+    with open(p) as f:
+        assert f.read().startswith("-- reject: AR009")
+
+
+# ============================================= not-compilable surfacing
+
+
+def test_segment_reject_reason():
+    from arroyo_tpu.engine.segment import (segment_marking,
+                                           segment_reject_reason)
+    from arroyo_tpu.expr import BinOp, Col, Lit
+
+    traceable = [("value", {"projections": [("x", BinOp("+", Col("x"),
+                                                        Lit(1)))]}),
+                 ("watermark", {"expr": Col("_timestamp")})]
+    assert segment_marking(traceable) is not None
+    assert segment_reject_reason(traceable) is None
+
+    short = [("value", {"projections": [("x", Col("x"))]}),
+             ("sink", {})]
+    assert segment_marking(short) is None
+    reason = segment_reject_reason(short)
+    assert reason is not None and reason.startswith("not compilable:")
+    # the STOP reason leads the string so truncating renderers (top's
+    # 48-char cell) keep the actionable part, not the boilerplate
+    assert "sink" in reason[:48]
+
+
+def test_executed_graph_view_not_compilable():
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.sql.planner import executed_graph_view
+
+    arroyo_tpu._load_operators()
+    _register_smoke()
+    cfg.update({"pipeline.chaining.enabled": True})
+    try:
+        nodes, _edges = executed_graph_view(
+            _sql("concat('p_', s)", cols="s TEXT, a BIGINT NOT NULL"))
+    finally:
+        cfg.update({"pipeline.chaining.enabled": False})
+    reasons = [n.get("not_compilable") for n in nodes
+               if n.get("not_compilable")]
+    assert reasons and all(r.startswith("not compilable:")
+                           for r in reasons)
+
+
+def test_explain_and_top_render_not_compiled():
+    from arroyo_tpu.obs.profile import render_explain
+    from arroyo_tpu.obs.topview import render
+
+    profile = {"chain_1": {"busy_pct": 12.0, "late_rows": 0,
+                           "segment_reason": "not compilable: operator "
+                                             "sink is not traceable"}}
+    nodes = [{"id": "chain_1", "op": "chained", "parallelism": 1}]
+    text = render_explain(nodes, [], profile, {"id": "j1", "state": "Running"})
+    assert "[not compiled: not compilable: operator sink" in text
+
+    # a plan-only node (no profile yet) still explains itself
+    nodes2 = [{"id": "chain_2", "op": "chained", "parallelism": 1,
+               "not_compilable": "not compilable: x"}]
+    text2 = render_explain(nodes2, [], {}, None)
+    assert "[not compilable: x]" in text2
+
+    metrics = {"chain_1": {"subtasks": 1, "messages_per_sec": 0.0,
+                           "segment_reason": "verification failed: x"}}
+    frame = render(
+        {"id": "j1", "state": "Running", "n_workers": 1}, metrics)
+    assert "[not compiled: verification failed: x]" in frame
+
+    # a realistic plan-time reject: top must keep the stop reason inside
+    # its truncated cell, not just the boilerplate prefix
+    metrics2 = {"chain_1": {
+        "subtasks": 1, "messages_per_sec": 0.0,
+        "segment_reason": "not compilable: operator sink is not "
+                          "traceable (traceable prefix 1 < 2)"}}
+    frame2 = render(
+        {"id": "j1", "state": "Running", "n_workers": 1}, metrics2)
+    assert "[not compiled: operator sink is not traceable" in frame2
+
+
+def test_runner_for_copies_reject_reason():
+    """metrics.segment_reason carries the plan-time reject so top/explain
+    explain interpreted chains without waiting for a runtime event."""
+    from arroyo_tpu.engine.segment import runner_for
+    from arroyo_tpu.metrics import TaskMetrics
+    from arroyo_tpu.operators.chained import ChainedOperator
+
+    arroyo_tpu._load_operators()
+    from arroyo_tpu import config as cfg
+
+    cfg.update({"segment.compile.enabled": True})
+    op = ChainedOperator({
+        "members": [("value", {"projections": None, "filter": None}),
+                    ("watermark", {"expr": None})],
+        "compile_reject": "not compilable: fixture reason",
+    })
+    m = TaskMetrics("j", "n", 0)
+    assert runner_for(op, None, m) is None
+    assert m.segment_reason == "not compilable: fixture reason"

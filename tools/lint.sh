@@ -18,8 +18,15 @@
 #   tools/lint.sh --rules-catalog
 #                              assert every LR/AR rule id registered in the
 #                              analysis engines (repo_lint.RULES,
-#                              state_audit.RULES, plan-pass AR literals)
-#                              appears in the README rule tables
+#                              state_audit.RULES, trace_audit.RULES,
+#                              plan-pass AR literals) appears in the README
+#                              rule tables
+#
+#   LINT_SARIF=findings.sarif tools/lint.sh
+#                              additionally write the lint findings as a
+#                              SARIF 2.1.0 document (CI renders them as
+#                              inline annotations); exit codes unchanged —
+#                              the plain lint run below still gates
 #
 # Exit non-zero on any unwaived lint finding or unexpected check result.
 set -euo pipefail
@@ -27,7 +34,20 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-python -m arroyo_tpu lint arroyo_tpu
+if [[ -n "${LINT_SARIF:-}" ]]; then
+    # ONE analysis run gates and emits the annotations (--sarif keeps the
+    # lint exit code); the human-readable report is re-rendered only on
+    # failure, when someone actually reads it
+    rc=0
+    python -m arroyo_tpu lint --sarif arroyo_tpu > "$LINT_SARIF" || rc=$?
+    if [[ $rc -ne 0 ]]; then
+        python -m arroyo_tpu lint arroyo_tpu || true
+        exit "$rc"
+    fi
+    echo "lint clean (SARIF written to $LINT_SARIF)"
+else
+    python -m arroyo_tpu lint arroyo_tpu
+fi
 
 if [[ "${1:-}" == "--metrics-catalog" ]]; then
     python - <<'EOF'
@@ -114,14 +134,16 @@ if [[ "${1:-}" == "--rules-catalog" ]]; then
     python - <<'EOF'
 import ast, re, sys
 
-from arroyo_tpu.analysis import AUDIT_RULES, LINT_RULES
+from arroyo_tpu.analysis import AUDIT_RULES, LINT_RULES, TRACE_RULES
 
-# every rule id an analysis engine can emit: the two registered rule
+# every rule id an analysis engine can emit: the three registered rule
 # tables, plus AR-series literals AST-walked out of the plan passes (they
 # register by function, not id) — each must appear in a README rule table
-rule_ids = {rid for rid, _sev, _fn in LINT_RULES} | set(AUDIT_RULES)
+rule_ids = {rid for rid, _sev, _fn in LINT_RULES} | set(AUDIT_RULES) \
+    | set(TRACE_RULES)
 ID_RE = re.compile(r"^(AR|LR)\d{3}$")
 for p in ("arroyo_tpu/analysis/plan_passes.py",
+          "arroyo_tpu/analysis/trace_audit.py",
           "arroyo_tpu/analysis/__init__.py"):
     with open(p) as f:
         tree = ast.parse(f.read(), p)
