@@ -102,6 +102,11 @@ MUTATORS = frozenset({
     "append", "appendleft", "add", "pop", "popleft", "popitem", "push",
     "extend", "extendleft", "update", "insert", "remove", "discard",
     "clear", "setdefault", "sort", "reverse", "rotate",
+    # tiered-state annex accessors (state/spill.py): probes tombstone what
+    # they promote and spills move ownership, so every one of these is a
+    # state mutation the replay contract must cover
+    "lookup_many", "scan_expired", "spill", "spill_rows", "probe",
+    "touch", "adopt",
 })
 
 _STATE_WAIVE_RE = re.compile(
@@ -224,6 +229,10 @@ class MethodModel:
     # locals built as plain dicts in this method: per-call insertion order,
     # reproducible on replay, so iterating them is order-safe
     local_det_dicts: set[str] = field(default_factory=set)
+    # (table_name_or_None, line) per checkpoint_manifest/restore_manifest
+    # call — the tiered-state manifest convention check (name must end in
+    # "__spill")
+    manifest_uses: list[tuple[Optional[str], int]] = field(default_factory=list)
     fn: Optional[ast.FunctionDef] = None
 
 
@@ -287,6 +296,16 @@ def _mine_method(fn: ast.FunctionDef, relpath: str) -> MethodModel:
             lit = arg.value if isinstance(arg, ast.Constant) and \
                 isinstance(arg.value, str) else None
             m.table_uses.append((lit, n.lineno))
+        if name in ("checkpoint_manifest", "restore_manifest"):
+            # tiered-state manifest helpers (state/spill.py): same
+            # second-argument table-name shape as persist_mark, and the
+            # name must follow the "<base>__spill" convention — the
+            # checkpoint metadata and spill-run GC both key on the suffix
+            arg = n.args[1] if len(n.args) > 1 else None
+            lit = arg.value if isinstance(arg, ast.Constant) and \
+                isinstance(arg.value, str) else None
+            m.table_uses.append((lit, n.lineno))
+            m.manifest_uses.append((lit, n.lineno))
         if name == "TableSpec":
             arg = n.args[0] if n.args else next(
                 (k.value for k in n.keywords if k.arg == "name"), None)
@@ -738,6 +757,27 @@ def audit_sweep(sweep: Sweep, mods: dict[str, ModuleInfo]
                         "but neither writes it at the barrier nor reads it "
                         "at restore",
                         "remove the declaration or wire the table"))
+
+        # ---- LR203b: the spill-manifest table name convention ------------
+        # checkpoint metadata lifts run references and the spill-run GC
+        # scans for liveness keyed on the "__spill" suffix: a manifest
+        # persisted under any other name checkpoints fine but its runs are
+        # invisible to GC liveness — they would be deleted under a live
+        # checkpoint
+        for mname, mm in sorted(model.own_methods.items()):
+            for lit, line in mm.manifest_uses:
+                if lit is None or lit.endswith("__spill"):
+                    continue
+                if _line_waiver(mods.get(mm.relpath), line, "LR203"):
+                    continue
+                diags.append(Diagnostic(
+                    "LR203", Severity.ERROR, f"{mm.relpath}:{line}",
+                    f"{cname}: spill manifest table {lit!r} does not end "
+                    "in '__spill': checkpoint metadata and spill-run GC "
+                    "both key on that suffix, so the runs this manifest "
+                    "references are invisible to liveness tracking and "
+                    "get deleted under a live checkpoint",
+                    "name the manifest table '<base>__spill'"))
 
         # ---- LR204: unordered iteration feeding emission -----------------
         unordered_attrs: set[str] = set()

@@ -94,6 +94,32 @@ _DEFAULTS: dict[str, Any] = {
         # completed epochs (never past the newest complete one). 0 = off.
         "compaction": {"epochs": 0},
     },
+    "state": {
+        # tiered state backend (state/spill.py): keep the hot working set
+        # in memory and spill cold hash-range partitions as parquet runs
+        # (bloom filter + min/max zone maps per run) to checkpoint storage
+        # once a subtask's resident state passes the budget. Off by
+        # default: operators fall back to fully-resident state.
+        "spill": {
+            "enabled": False,
+            # per-subtask resident-state budget, measured with the same
+            # estimator that feeds the arroyo_state_bytes gauges
+            "budget-bytes": 64 * 1024 * 1024,
+            # hash-range partitions per subtask (rounded up to a power of
+            # two); the spill/eviction granularity
+            "partition-count": 16,
+            # split spilled runs into files of roughly this size; also the
+            # compaction output granularity
+            "target-file-bytes": 4 * 1024 * 1024,
+            # generations per partition before an online compaction merges
+            # them (bounds probe read amplification)
+            "max-runs": 4,
+            # after a spill, keep shrinking until resident state is at or
+            # below budget * headroom (a low-water mark, so every breach
+            # does not trigger a new spill immediately)
+            "headroom": 0.75,
+        },
+    },
     "storage": {
         # shared resilience layer (utils/retry.py) for object-store ops
         "retry": {
@@ -145,6 +171,10 @@ _DEFAULTS: dict[str, Any] = {
         "queue-transit-p99-max-ms": 1000.0,
         "sink-latency-p99-max-s": 600.0,
         "checkpoint-failure-streak": 2,
+        # memory pressure: worst subtask's resident state bytes as a
+        # fraction of state.spill.budget-bytes (spill keeps it below 1.0;
+        # sustained breach means spill is off, failing, or falling behind)
+        "memory-pressure-max": 0.9,
     },
     "autoscaler": {
         # elastic autoscaler (controller/autoscaler.py): closes the loop

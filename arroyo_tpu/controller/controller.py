@@ -484,11 +484,17 @@ class JobController:
         self._epochs_since_gc = 0
 
         def _run_gc() -> None:
+            from ..state.spill import cleanup_spill_runs
             from ..state.tables import cleanup_checkpoints, compact_job
 
             try:
                 compact_job(self.storage_url, self.job_id, newest_epoch)
                 cleanup_checkpoints(self.storage_url, self.job_id, newest_epoch)
+                # tiered-state runs outlive single epochs; with the old
+                # epochs gone, delete every run no surviving checkpoint
+                # references (fresh post-checkpoint runs are epoch-tagged
+                # and always kept)
+                cleanup_spill_runs(self.storage_url, self.job_id, newest_epoch)
                 self.db.record_checkpoint(self.job_id, newest_epoch, "compacted")
             except Exception:  # noqa: BLE001 - GC is best-effort maintenance
                 _log.exception("checkpoint GC failed for %s at epoch %d",

@@ -57,6 +57,16 @@ Instrumented sites:
                         target, direction): force=N substitutes a bogus
                         target the min/max rails must clamp, drop
                         suppresses the decision, fail costs one tick
+    spill_write         tiered-state run write (state/spill.py; ctx:
+                        key=path, epoch, subtask): a failure re-pins the
+                        partition hot (SPILL_FALLBACK), never loses state
+    spill_probe         tiered-state run read on the probe path (ctx: key,
+                        epoch, subtask): retried once in place; a second
+                        failure propagates so the set restores from the
+                        checkpoint instead of inventing data
+    spill_compact       spill-generation merge write (ctx: key, epoch,
+                        subtask): a failure keeps the old generations —
+                        more read amplification, zero correctness impact
 """
 
 from __future__ import annotations
@@ -86,7 +96,7 @@ SITES = (
     "storage.multipart", "network.send", "network.recv", "queue.put",
     "connector.poll", "connector.commit", "worker", "worker.heartbeat",
     "node.start_worker", "controller_rpc", "commit", "rescale",
-    "autoscale_decide",
+    "autoscale_decide", "spill_write", "spill_probe", "spill_compact",
 )
 
 

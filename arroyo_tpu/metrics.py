@@ -113,7 +113,7 @@ class TaskMetrics:
                  "sink_event_latency", "watermark_micros", "self_time",
                  "self_cpu", "late_rows", "state_rows", "state_bytes",
                  "sketch", "started_monotonic", "segment_compiled",
-                 "segment_reason")
+                 "segment_reason", "spill")
 
     def __init__(self, job_id: str, node_id: str, subtask: int):
         self.job_id = job_id
@@ -156,6 +156,10 @@ class TaskMetrics:
         # fallback reason (SEGMENT_FALLBACK) — `top` and `explain` render
         # it next to the [compiled] marker
         self.segment_reason: Optional[str] = None
+        # tiered state (state/spill.py): {"bytes_total", "hot", "cold",
+        # "probe_files": Histogram}, set by TaskProfiler.refresh from the
+        # operator's spill_stats() hook; None while nothing ever spilled
+        self.spill: Optional[dict] = None
 
     def histogram(self, name: str) -> Histogram:
         # explicit mapping: an unknown/typoed name must fail loudly at the
@@ -341,6 +345,26 @@ class MetricsRegistry:
                     f"arroyo_state_bytes{{{label}}} "
                     f"{t.state_bytes.get(table, 0)}")
 
+        # tiered state (state/spill.py): cumulative spilled bytes, the
+        # hot/cold partition split, and the files-touched-per-probe
+        # histogram (the bloom/zone-map pruning-effectiveness signal)
+        spill_tasks = [t for t in tasks if t.spill]
+        if spill_tasks:
+            lines.append("# TYPE arroyo_spill_bytes_total counter")
+            lines.append("# TYPE arroyo_spill_partitions gauge")
+            for t in spill_tasks:
+                label = (f'job="{t.job_id}",operator="{t.node_id}",'
+                         f'subtask="{t.subtask}"')
+                lines.append(
+                    f"arroyo_spill_bytes_total{{{label}}} "
+                    f"{t.spill['bytes_total']}")
+                lines.append(
+                    f'arroyo_spill_partitions{{{label},state="hot"}} '
+                    f"{t.spill['hot']}")
+                lines.append(
+                    f'arroyo_spill_partitions{{{label},state="cold"}} '
+                    f"{t.spill['cold']}")
+
         def emit_histogram(name: str, label: str, h: Histogram) -> None:
             cum = 0
             for le, c in zip(h.buckets, h.counts):
@@ -359,6 +383,15 @@ class MetricsRegistry:
                 label = (f'job="{t.job_id}",operator="{t.node_id}",'
                          f'subtask="{t.subtask}"')
                 emit_histogram(name, label, h)
+        if spill_tasks:
+            lines.append("# TYPE arroyo_spill_probe_files histogram")
+            for t in spill_tasks:
+                h = t.spill.get("probe_files")
+                if h is None or not h.count:
+                    continue
+                label = (f'job="{t.job_id}",operator="{t.node_id}",'
+                         f'subtask="{t.subtask}"')
+                emit_histogram("arroyo_spill_probe_files", label, h)
         with self._lock:
             phase_hists = sorted(self._phases.items())
             job_health = sorted(self._job_health.items())

@@ -86,6 +86,14 @@ EVENT_CODES: dict[str, tuple[str, str]] = {
         "WARN", "a marked segment could not trace (or its first-batch "
                 "verification diverged) and degraded to the interpreted "
                 "per-operator path for this run; data carries the reason"),
+    "SPILL_STARTED": (
+        "INFO", "tiered state engaged: a subtask's resident state passed "
+                "its budget and cold partitions began spilling to storage "
+                "(data: table, partition, rows, bytes)"),
+    "SPILL_FALLBACK": (
+        "WARN", "a spill or spill-compaction write failed after retries; "
+                "the state stays resident (re-pinned hot) and spilling "
+                "backs off — degraded, never corrupted (data: reason)"),
     "LOG": (
         "INFO", "a stdlib logging record carrying job context, bridged by "
                 "the logging.capture-events handler"),

@@ -60,6 +60,31 @@ class Rule:
         return float(v) if v is not None else self.default
 
 
+def _observe_memory_pressure(ctx: dict) -> Optional[float]:
+    """Worst subtask's resident state bytes as a fraction of the
+    per-subtask spill budget (``state.spill.budget-bytes``). Fed from the
+    same ``arroyo_state_bytes`` accounting the spill layer enforces its
+    budget against: a sustained breach means spilling is disabled,
+    failing (SPILL_FALLBACK), or falling behind the ingest rate."""
+    from ..config import config
+
+    budget = config().get("state.spill.budget-bytes")
+    if not budget:
+        return None
+    worst = None
+    for m in (ctx.get("metrics") or {}).values():
+        if not isinstance(m, dict):
+            continue
+        for s in (m.get("per_subtask") or {}).values():
+            sb = (s or {}).get("state_bytes") or {}
+            if not sb:
+                continue
+            v = sum(sb.values()) / float(budget)
+            if worst is None or v > worst:
+                worst = v
+    return worst
+
+
 RULES: tuple[Rule, ...] = (
     Rule("watermark-lag", "degraded", "watermark-lag-max-s", 900.0,
          "worst-operator watermark lag (event time falling behind)",
@@ -77,6 +102,9 @@ RULES: tuple[Rule, ...] = (
     Rule("checkpoint-failures", "critical", "checkpoint-failure-streak", 2.0,
          "consecutive failed/wedged checkpoint epochs",
          lambda ctx: float(ctx.get("ckpt_failures") or 0)),
+    Rule("memory-pressure", "degraded", "memory-pressure-max", 0.9,
+         "worst subtask's resident state vs the per-subtask spill budget",
+         _observe_memory_pressure),
 )
 
 

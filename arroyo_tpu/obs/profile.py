@@ -172,6 +172,11 @@ class TaskProfiler:
                 nbytes[name] = int(by)
         m.state_rows = rows
         m.state_bytes = nbytes
+        spill = getattr(self.op, "spill_stats", None)
+        if spill is not None:
+            # tiered state (state/spill.py): spilled bytes, hot/cold
+            # partition split, and probe-pruning histogram -> arroyo_spill_*
+            m.spill = spill()
 
 
 def make_profiler(metrics, task_info, table_manager, op) -> Optional[TaskProfiler]:

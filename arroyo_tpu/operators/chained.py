@@ -120,6 +120,15 @@ class ChainedOperator(Operator):
                     out[f"c{i}.{name}"] = v
         return out
 
+    def spill_stats(self):
+        """Members' tiered-state counters folded into one chain-level
+        block (state/spill.py merge: counters sum, histograms add)."""
+        from ..state.spill import merge_spill_stats
+
+        return merge_spill_stats(
+            [fn() for m in self.members
+             for fn in (getattr(m, "spill_stats", None),) if fn is not None])
+
     def tables(self):
         specs = []
         for i, m in enumerate(self.members):

@@ -483,6 +483,8 @@ class JoinWithExpiration(Operator):
     """
 
     def __init__(self, cfg: dict):
+        from ..state.spill import spill_enabled
+
         self.join_type: str = cfg.get("join_type", "inner")
         self.left_names: list[tuple[str, str]] = list(cfg["left_names"])
         self.right_names: list[tuple[str, str]] = list(cfg["right_names"])
@@ -493,11 +495,19 @@ class JoinWithExpiration(Operator):
         # as arroyo_late_rows_total (counting only — expiry semantics are
         # unchanged)
         self.late_rows = 0  # state: ephemeral — observability counter (obs/profile.py export); never read into emitted data
+        # tiered state (state/spill.py): cold side-store rows (oldest event
+        # times) spill as bloom/zone-mapped runs; a probe that hits a
+        # spilled key promotes its rows back into the live store first, so
+        # the join logic itself never changes
+        self._spill = spill_enabled()
+        self._annexes = None  # (RowSpillAnnex, RowSpillAnnex) in on_start
 
     def tables(self):
         return [
             TableSpec("left", "expiring_time_key", retention_micros=self.ttl),
             TableSpec("right", "expiring_time_key", retention_micros=self.ttl),
+            TableSpec("left__spill", "global_keyed"),
+            TableSpec("right__spill", "global_keyed"),
         ]
 
     def _outer_for(self, side: int) -> bool:
@@ -527,6 +537,25 @@ class JoinWithExpiration(Operator):
     # ------------------------------------------------------------------
 
     def on_start(self, ctx):
+        if self._spill:
+            from ..state.spill import (RowSpillAnnex, SpillStats,
+                                       restore_manifest)
+
+            stats = SpillStats()  # one shared stats block for both sides
+            self._annexes = tuple(
+                RowSpillAnnex(ctx.task_info, ctx.table_manager.storage_url,
+                              name, len(self._src_names(side)), stats)
+                for side, name in ((0, "left"), (1, "right")))
+            self._annexes[0].adopt(restore_manifest(ctx, "left__spill"))
+            self._annexes[1].adopt(restore_manifest(ctx, "right__spill"))
+        else:
+            from ..state.spill import require_spill_for_manifest
+
+            # spilled side-store rows exist only in run files: restoring
+            # with spilling disabled must fail loudly, not silently drop
+            # buffered join state
+            require_spill_for_manifest(ctx, "left__spill")
+            require_spill_for_manifest(ctx, "right__spill")
         for side, name in ((0, "left"), (1, "right")):
             tbl = ctx.table_manager.expiring_time_key(name, self.ttl)
             store = self.stores[side]
@@ -555,6 +584,14 @@ class JoinWithExpiration(Operator):
             if IS_RETRACT_FIELD in batch
             else None
         )
+        if self._annexes is not None:
+            # any spilled row this batch's keys could touch promotes back
+            # into the live store FIRST (match counts and null pads mutate,
+            # and runs are immutable), so the probe/retract logic below is
+            # byte-identical to the fully-resident path
+            self._promote(1 - side, keys)
+            if retracts is not None and retracts.any():
+                self._promote(side, keys[retracts])
         srcs = [src for _o, src in self._src_names(side)]
         src_cols = [np.asarray(batch[s]) for s in srcs]
         out: list[tuple] = []  # emission segments, in order
@@ -577,6 +614,65 @@ class JoinWithExpiration(Operator):
                                      [c[lo:hi] for c in src_cols], out)
         if out:
             self._emit(out, collector)
+
+    def _promote(self, side: int, keys: np.ndarray) -> None:
+        """Pull every alive spilled row of ``side`` whose key appears in
+        ``keys`` back into the live store (bloom/zone pruned)."""
+        annex = self._annexes[side]
+        if not annex.has_runs() or not len(keys):
+            return
+        seg = annex.probe(keys)
+        if seg is not None:
+            k, t, mc, ne, vals = seg
+            self.stores[side].append(k, t, vals, mc, ne)
+
+    def spill_stats(self):
+        if self._annexes is None:
+            return None
+        stats = self._annexes[0].stats  # shared by both sides
+        cold = sum(1 for a in self._annexes if a.has_runs())
+        return {"bytes_total": stats.bytes_total, "hot": 2 - cold,
+                "cold": cold, "probe_files": stats.probe_files}
+
+    def _maybe_spill(self) -> None:
+        """Budget enforcement across BOTH side stores: the globally oldest
+        rows (event time, then side/position as the deterministic
+        tie-break) spill first, down to the low-water mark."""
+        from ..config import config
+        from ..state.spill import spill_budget_bytes
+
+        if self._annexes is None:
+            return
+        sizes = self.state_sizes()
+        total = sum(b for _r, b in sizes.values())
+        budget = spill_budget_bytes()
+        if total <= budget:
+            return
+        target = budget * float(config().get("state.spill.headroom", 0.75))
+        parts = []
+        for s in (0, 1):
+            live = self.stores[s].live_ids()
+            if len(live):
+                parts.append((self.stores[s].ts[live],
+                              np.full(len(live), s, dtype=np.int64), live))
+        if not parts:
+            return
+        ts_all = np.concatenate([p[0] for p in parts])
+        side_all = np.concatenate([p[1] for p in parts])
+        ids_all = np.concatenate([p[2] for p in parts])
+        per_row = max(8 * (3 + len(st.vals)) + 2 for st in self.stores)
+        k = min(len(ts_all), int((total - target) / max(per_row, 1)) + 1)
+        pick = np.lexsort((ids_all, side_all, ts_all))[:k]
+        for s in (0, 1):
+            sel = ids_all[pick[side_all[pick] == s]]
+            if not len(sel):
+                continue
+            store = self.stores[s]
+            ok = self._annexes[s].spill_rows(
+                store.keys[sel], store.ts[sel], store.match_count[sel],
+                store.null_emitted[sel], [c[sel] for c in store.vals])
+            if ok:
+                store.kill(sel)
 
     def _append_run(self, side: int, keys, ts, src_cols, out: list) -> None:
         """Vectorized append path: probe the other side once, scatter-add
@@ -697,6 +793,17 @@ class JoinWithExpiration(Operator):
             if len(live):
                 lo = int(store.ts[live].min())
                 oldest = lo if oldest is None else min(oldest, lo)
+        if self._annexes is not None:
+            # spilled rows age out too (zone-map gated, whole-run drops when
+            # possible), and alive cold rows hold the watermark exactly like
+            # resident ones; the budget check runs here — off the per-batch
+            # hot path, after expiry freed whatever it could
+            for annex in self._annexes:
+                self.late_rows += annex.expire(cutoff)
+                lo = annex.oldest_ts()
+                if lo is not None:
+                    oldest = lo if oldest is None else min(oldest, lo)
+            self._maybe_spill()
         # future emissions carry ts = max(sides) >= the oldest buffered row;
         # hold the watermark to that bound so downstream never sees late rows
         held = watermark.value if oldest is None else min(watermark.value, oldest)
@@ -705,6 +812,16 @@ class JoinWithExpiration(Operator):
         return Watermark.event_time(held)
 
     def handle_checkpoint(self, barrier, ctx, collector):
+        if self._annexes is not None:
+            from ..state.spill import checkpoint_manifest
+
+            for a in self._annexes:
+                a.epoch = barrier.epoch
+            self._maybe_spill()
+            # spilled runs checkpoint BY REFERENCE: the manifest (run list,
+            # dead-row sets) rides the epoch; the files are never re-uploaded
+            checkpoint_manifest(ctx, "left__spill", self._annexes[0])
+            checkpoint_manifest(ctx, "right__spill", self._annexes[1])
         for side, name in ((0, "left"), (1, "right")):
             tbl = ctx.table_manager.expiring_time_key(name, self.ttl)
             store = self.stores[side]

@@ -43,6 +43,20 @@ class _KeyState:
         self.last_update = last_update  # event-time micros for TTL
 
 
+def _pack_key_state(st: _KeyState, kv) -> tuple:
+    """Spill payload for one key (state/spill.py pack contract: the event
+    time rides at index -1 so the annex can zone-map runs without
+    unpickling)."""
+    return (tuple(st.accs), st.count, st.emitted, kv, st.last_update)
+
+
+def _unpack_key_state(packed: tuple) -> tuple[_KeyState, Optional[tuple]]:
+    accs, count, emitted, kv, last_update = packed
+    st = _KeyState(list(accs), int(count), int(last_update))
+    st.emitted = emitted
+    return st, (tuple(kv) if kv is not None else None)
+
+
 class UpdatingAggregate(Operator):
     """config: key_fields, aggregates: [(name, kind, Expr|None)],
     flush_interval_micros (default 1s), ttl_micros (default 1 day),
@@ -75,9 +89,19 @@ class UpdatingAggregate(Operator):
         backend = cfg.get("backend") or (
             "jax" if config().get("device.enabled") else "numpy"
         )
+        # tiered state (state/spill.py): with spilling on, the keyed
+        # accumulator map runs on the host path — the hot working set stays
+        # in self.state and cold hash-range partitions live in the annex.
+        # (The device store is capacity-bound HBM; larger-than-RAM keyspaces
+        # are exactly the case it cannot hold.)
+        from ..state.spill import spill_enabled
+
+        self._spill = spill_enabled()
+        self._annex = None  # KeyedSpillAnnex, built in on_start when spilling
         self.device_mode = (
             backend == "jax"
             and all(k in ("sum", "count") for k in self.acc_kinds)
+            and not self._spill
         )
         # the device store always carries a count lane (±1 per row): it is
         # the liveness/ordering ground truth even when the SQL has no
@@ -98,14 +122,30 @@ class UpdatingAggregate(Operator):
     def tables(self):
         # "m" holds the event-time high-water mark (global: persists even
         # when the key snapshot is empty, where a column on "s" would be
-        # silently dropped with the 0-row batch)
+        # silently dropped with the 0-row batch); "s__spill" holds the
+        # tiered-state manifest — spilled runs by reference, never
+        # re-uploaded (state/spill.py; written only when spilling is on)
         return [TableSpec("s", "expiring_time_key", retention_micros=self.ttl),
-                TableSpec("m", "global_keyed")]
+                TableSpec("m", "global_keyed"),
+                TableSpec("s__spill", "global_keyed")]
 
     def tick_interval_micros(self):
         return self.flush_interval
 
     def on_start(self, ctx):
+        if self._spill:
+            from ..state.spill import KeyedSpillAnnex, restore_manifest
+
+            self._annex = KeyedSpillAnnex(
+                ctx.task_info, ctx.table_manager.storage_url, "s")
+            self._annex.adopt(restore_manifest(ctx, "s__spill"))
+        else:
+            from ..state.spill import require_spill_for_manifest
+
+            # a checkpoint taken WITH spilling holds most of the keyspace
+            # in run files; restoring hot rows alone would silently
+            # corrupt — fail the restore instead
+            require_spill_for_manifest(ctx, "s__spill")
         # event-time high-water mark: stamps emitted rows and anchors TTL
         # eviction, so replayed emissions carry the original timestamps.
         # DATA-derived and therefore per-subtask (unlike the watermark-
@@ -199,6 +239,8 @@ class UpdatingAggregate(Operator):
         if self.device_mode:
             self._process_device(hashes, ts, retracts, vals, batch)
             return
+        if self._annex is not None:
+            self._ensure_hot(hashes)
         order = np.argsort(hashes, kind="stable")
         k_s = hashes[order]
         r_s = retracts[order]
@@ -260,6 +302,113 @@ class UpdatingAggregate(Operator):
                     cur = max(cur, app.max()) if len(app) else cur
                 st.accs[i] = self.acc_dtypes[i].type(cur)
             self.updated.add(h)
+        if self._annex is not None:
+            self._maybe_spill()
+
+    # --------------------------------------------------------- tiered state
+
+    def _ensure_hot(self, hashes: np.ndarray) -> None:
+        """Promote every batch key with a cold (spilled) copy into the hot
+        dict before the fold loop touches it — the probe is one bloom/zone
+        pruned pass per batch, never per key."""
+        annex = self._annex
+        uniq = np.unique(hashes)
+        annex.touch(uniq)
+        if not annex.has_runs():
+            return
+        missing = [h for h in uniq.tolist() if h not in self.state]
+        if not missing:
+            return
+        for h, packed in sorted(annex.lookup_many(missing).items()):
+            st, kv = _unpack_key_state(packed)
+            self.state[h] = st
+            if kv is not None:
+                self.key_values[h] = kv
+
+    def _entry_nbytes(self, h: int, st: _KeyState) -> int:
+        """Resident-bytes floor for one key (same role as the join's
+        per-row estimate: feeds arroyo_state_bytes AND the spill budget)."""
+        import sys as _sys
+
+        b = 160  # dict slots + _KeyState object overhead
+        for a in st.accs:
+            b += (_sys.getsizeof(a) + 64 * len(a)) if isinstance(a, dict) \
+                else 32
+        if st.emitted is not None:
+            b += 56 + 32 * len(st.emitted)
+        kv = self.key_values.get(h)
+        if kv is not None:
+            b += 56 + sum(_sys.getsizeof(v) for v in kv)
+        return b
+
+    def _estimate_state_bytes(self) -> tuple[int, float]:
+        """(estimated resident bytes, per-entry average), sampled over up
+        to 64 entries so the per-batch budget check stays O(1)."""
+        import itertools as _it
+
+        n = len(self.state)
+        if not n:
+            return 0, 0.0
+        tot = cnt = 0
+        for h, st in _it.islice(self.state.items(), 64):
+            tot += self._entry_nbytes(h, st)
+            cnt += 1
+        per = tot / cnt
+        return int(per * n), per
+
+    def state_sizes(self) -> dict[str, tuple[int, int]]:
+        """Live resident-state gauge for the host path (between barriers
+        the "s" table lags the in-memory map; device mode keeps the
+        as-of-barrier table view)."""
+        if self.device_mode:
+            return {}
+        est, _per = self._estimate_state_bytes()
+        return {"s": (len(self.state), est)}
+
+    def spill_stats(self) -> Optional[dict]:
+        annex = self._annex
+        if annex is None:
+            return None
+        cold = annex.cold_partitions()
+        return {"bytes_total": annex.stats.bytes_total,
+                "hot": max(0, annex.local_partitions() - cold), "cold": cold,
+                "probe_files": annex.stats.probe_files}
+
+    def _maybe_spill(self) -> None:
+        """Budget enforcement: when resident state passes
+        ``state.spill.budget-bytes``, spill the coldest partitions (the
+        annex's deterministic clock-LRU) down to the low-water mark."""
+        from ..config import config
+        from ..state.spill import spill_budget_bytes
+
+        annex = self._annex
+        if annex is None or not self.state:
+            return
+        budget = spill_budget_bytes()
+        est_total, per_entry = self._estimate_state_bytes()
+        if est_total <= budget:
+            return
+        target = budget * float(config().get("state.spill.headroom", 0.75))
+        excess = int((est_total - target) / max(per_entry, 1.0)) + 1
+        # keys with pending un-flushed updates are spillable too (the next
+        # _flush promotes them back): budget enforcement must not depend
+        # on the watermark cadence that clears the updated set. The clock
+        # LRU keeps their (just-touched) partitions at the back of the
+        # victim line anyway.
+        hot_by_p: dict[int, list[int]] = {}
+        for h in self.state:
+            hot_by_p.setdefault(annex.partition_of(h), []).append(h)
+        victims = annex.pick_victims(
+            {p: len(ks) for p, ks in hot_by_p.items()}, excess)
+        for p in victims:
+            items = [(h, _pack_key_state(self.state[h],
+                                         self.key_values.get(h)))
+                     for h in hot_by_p[p]]
+            if not annex.spill(p, items):
+                return  # degraded (SPILL_FALLBACK): stay resident, back off
+            for h in hot_by_p[p]:
+                self.state.pop(h, None)
+                self.key_values.pop(h, None)
 
     def _identity(self, i: int):
         if self.acc_kinds[i] == "collect":
@@ -464,6 +613,17 @@ class UpdatingAggregate(Operator):
             return
         out_rows: list[tuple[int, tuple, bool]] = []  # (hash, values, is_retract)
         dead: list[int] = []
+        if self._annex is not None:
+            # a key can be spilled with its update pending (budget pressure
+            # between flushes): promote it back so its emission reads the
+            # exact accumulated state
+            missing = sorted(h for h in self.updated if h not in self.state)
+            if missing:
+                for h, pk in sorted(self._annex.lookup_many(missing).items()):
+                    st, kv = _unpack_key_state(pk)
+                    self.state[h] = st
+                    if kv is not None:
+                        self.key_values[h] = kv
         for h in sorted(self.updated):
             st = self.state.get(h)
             if st is None:
@@ -482,6 +642,18 @@ class UpdatingAggregate(Operator):
             st.emitted = new_vals
         self.updated.clear()
         if evict_before is not None:
+            if self._annex is not None:
+                # cold keys expire too: promote every spilled key whose
+                # newest copy is past the TTL so the eviction sweep below
+                # retracts it exactly like a resident one (zone-map gated —
+                # no file is read until the cutoff passes the oldest
+                # surviving spilled row)
+                for h, packed in self._annex.scan_expired(
+                        evict_before, self.state.keys()):
+                    st, kv = _unpack_key_state(packed)
+                    self.state[h] = st
+                    if kv is not None:
+                        self.key_values[h] = kv
             dead_set = set(dead)
             # sorted: dict order diverges after a restore (rebuilt in
             # checkpoint-file order), so eviction retractions must not
@@ -535,6 +707,15 @@ class UpdatingAggregate(Operator):
         # RAW value, 0 included: a no-data subtask must restore its own 0,
         # not fall into the rescale merge and adopt a peer's higher mark
         persist_mark(ctx, "m", self.max_event_time)
+        if self._annex is not None:
+            from ..state.spill import checkpoint_manifest
+
+            # one consistent tiered view per epoch: enforce the budget,
+            # then snapshot — hot rows into "s" below, spilled runs BY
+            # REFERENCE into the manifest (never re-uploaded)
+            self._annex.epoch = barrier.epoch
+            self._maybe_spill()
+            checkpoint_manifest(ctx, "s__spill", self._annex)
         if self.device_mode:
             self._checkpoint_device(ctx)
             return

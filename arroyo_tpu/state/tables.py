@@ -317,6 +317,13 @@ class TableManager:
         for name, table in self.globals.items():
             meta = table.write_checkpoint(os.path.join(opdir, f"table-{name}-{sub}.bin"))
             meta["table"] = name
+            if name.endswith("__spill"):
+                # tiered-state manifest table (state/spill.py): lift the
+                # referenced run file names into the checkpoint metadata so
+                # spill-run GC can see liveness without unpickling tables
+                from .spill import manifest_run_files
+
+                meta["spill_runs"] = manifest_run_files(table.data)
             files.append(meta)
         ext = "parquet" if _checkpoint_format() == "parquet" else "npz"
         for name, table in self.expiring.items():
@@ -494,6 +501,11 @@ def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
                 data.update(pickle.loads(storage.read_bytes(os.path.join(opdir, fm["file"]))))
             storage.write_bytes(out_path, pickle.dumps(data))
             merged = dict(fmetas[0])
+            if any("spill_runs" in fm for fm in fmetas):
+                # a merged __spill manifest table still references every
+                # subtask's runs — the GC liveness union must not shrink
+                merged["spill_runs"] = sorted(
+                    {rf for fm in fmetas for rf in fm.get("spill_runs", ())})
         else:
             col_parts = [read_columnar(os.path.join(opdir, fm["file"])) for fm in fmetas]
             names = col_parts[0].keys()

@@ -89,6 +89,21 @@ def gen_orders():
     return rows
 
 
+def gen_spill_users():
+    """Wide-keyspace event stream for the tiered-state smoke family: ~1200
+    distinct users over 4000 rows, sized so a few-tens-of-KB spill budget
+    is ~10x smaller than the resident keyed state."""
+    rows = []
+    for i in range(4000):
+        ts = BASE + i * 50_000
+        rows.append({
+            "timestamp": iso_tz(ts),
+            "user_id": (i * 37) % 1200,
+            "amount": (i * 13) % 500,
+        })
+    return rows
+
+
 def gen_customers():
     rows = []
     for i in range(15):
@@ -319,6 +334,15 @@ def o_updating_aggregate(ins):
         byg[r["counter"] % 7].append(r["counter"])
     return [
         {"g": g, "c": len(cs), "total": sum(cs)} for g, cs in sorted(byg.items())
+    ]
+
+
+def o_spill_keyspace(ins):
+    byu = defaultdict(list)
+    for r in ins["spill_users"]:
+        byu[r["user_id"]].append(r["amount"])
+    return [
+        {"u": u, "c": len(a), "total": sum(a)} for u, a in sorted(byu.items())
     ]
 
 
@@ -696,6 +720,7 @@ ORACLES = {
     "windowed_inner_join": o_windowed_inner_join,
     "windowed_full_join": o_windowed_full_join,
     "updating_aggregate": o_updating_aggregate,
+    "spill_keyspace": o_spill_keyspace,
     "filter_updating_aggregates": o_filter_updating_aggregates,
     "updating_inner_join": o_updating_inner_join,
     "updating_left_join": o_updating_left_join,
@@ -723,6 +748,7 @@ ORACLES = {
 # engine output before diffing; goldens hold the final merged rows)
 UPDATING = {
     "updating_aggregate",
+    "spill_keyspace",
     "filter_updating_aggregates",
     "updating_inner_join",
     "updating_left_join",
@@ -744,6 +770,7 @@ def main():
         "bids": gen_bids(),
         "orders": gen_orders(),
         "customers": gen_customers(),
+        "spill_users": gen_spill_users(),
     }
     for name, rows in ins.items():
         with open(os.path.join(INPUTS, f"{name}.json"), "w") as f:

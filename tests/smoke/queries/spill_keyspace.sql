@@ -1,0 +1,31 @@
+-- Tiered-state smoke family (ISSUE 14): an updating aggregate over a
+-- keyspace ~10x a small state.spill.budget-bytes. tests/test_spill.py runs
+-- this family with spilling enabled (tiny budget, chaos axes included) and
+-- asserts byte-exact goldens with spill actively engaged; the default
+-- (spill-off) smoke/segment sweeps prove the resident path on the same
+-- golden.
+CREATE TABLE spill_users (
+  timestamp TIMESTAMP,
+  user_id BIGINT NOT NULL,
+  amount BIGINT NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/spill_users.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE spill_output (
+  u BIGINT,
+  c BIGINT,
+  total BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO spill_output
+SELECT user_id AS u, count(*) AS c, CAST(sum(amount) AS BIGINT) AS total
+FROM spill_users
+GROUP BY user_id;

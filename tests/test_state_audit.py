@@ -282,6 +282,80 @@ class C(Operator):
     assert "LR203" not in ids_of(audit(src))
 
 
+# ------------------------------------------- tiered-state spill manifests
+
+
+SPILL_SOUND = """
+from arroyo_tpu.operators.base import Operator, TableSpec
+from arroyo_tpu.state.spill import checkpoint_manifest, restore_manifest
+
+class SpillSound(Operator):
+    def tables(self):
+        return [TableSpec("s__spill", "global_keyed")]
+
+    def on_start(self, ctx):
+        self.annex = build_annex(ctx)
+        self.annex.adopt(restore_manifest(ctx, "s__spill"))
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        self.annex.lookup_many([1])
+        self.annex.spill(0, [])
+
+    def handle_checkpoint(self, barrier, ctx, collector):
+        checkpoint_manifest(ctx, "s__spill", self.annex)
+"""
+
+
+def test_spill_annex_checkpoint_covered_is_clean():
+    """The positive half of the manifest pair: annex probed/spilled on the
+    hot path, manifest checkpointed at the barrier and re-adopted in
+    on_start — covered, symmetric, convention-following."""
+    assert not audit(SPILL_SOUND)
+
+
+def test_spill_annex_unchreckpointed_manifest_fires_lr201():
+    """The negative half: the annex mutates on the hot path (probes
+    tombstone what they promote; spills move ownership) but nothing ever
+    checkpoints or restores its manifest — a restore silently forgets
+    which runs exist and every spilled key resurrects stale or vanishes."""
+    src = """
+from arroyo_tpu.operators.base import Operator
+
+class SpillLeaky(Operator):
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        self.annex.lookup_many([1, 2])
+"""
+    diags = audit(src)
+    assert any(d.rule_id == "LR201" and "annex" in d.message for d in diags)
+
+
+def test_spill_manifest_name_convention_fires_lr203():
+    """A manifest persisted under a table name without the ``__spill``
+    suffix checkpoints fine but is invisible to spill-run GC liveness —
+    the convention is enforced, both directions (write and restore)."""
+    src = """
+from arroyo_tpu.operators.base import Operator, TableSpec
+from arroyo_tpu.state.spill import checkpoint_manifest, restore_manifest
+
+class C(Operator):
+    def tables(self):
+        return [TableSpec("manifest", "global_keyed")]
+
+    def on_start(self, ctx):
+        self.annex = build_annex(ctx)
+        self.annex.adopt(restore_manifest(ctx, "manifest"))
+
+    def process_batch(self, batch, ctx, collector, input_index=0):
+        self.annex.lookup_many([1])
+
+    def handle_checkpoint(self, barrier, ctx, collector):
+        checkpoint_manifest(ctx, "manifest", self.annex)
+"""
+    diags = audit(src)
+    hits = [d for d in diags if d.rule_id == "LR203" and "__spill" in d.message]
+    assert hits, diags
+
+
 # ------------------------------------------------------------------- LR204
 
 
