@@ -62,9 +62,13 @@ class ArroyoClient:
         return self._req("POST", "/api/v1/pipelines/validate", {"query": query})
 
     def create_pipeline(self, query: str, name: str = "pipeline",
-                        parallelism: int = 1) -> dict:
-        return self._req("POST", "/api/v1/pipelines",
-                         {"name": name, "query": query, "parallelism": parallelism})
+                        parallelism: int = 1,
+                        tenant: Optional[str] = None) -> dict:
+        body = {"name": name, "query": query, "parallelism": parallelism}
+        if tenant is not None:
+            # keys the fleet's per-tenant admission queues and quotas
+            body["tenant"] = tenant
+        return self._req("POST", "/api/v1/pipelines", body)
 
     def list_pipelines(self) -> list[dict]:
         return self._req("GET", "/api/v1/pipelines")["data"]
@@ -144,6 +148,11 @@ class ArroyoClient:
         threshold, and firing flag, plus the elastic autoscaler's rail
         state and last decision under the ``autoscaler`` key."""
         return self._req("GET", f"/api/v1/jobs/{job_id}/health")
+
+    def fleet_status(self) -> dict:
+        """Multi-tenant fleet snapshot: pool occupancy, per-tenant usage,
+        and the admission queue with positions."""
+        return self._req("GET", "/api/v1/fleet")
 
     def list_connectors(self) -> dict:
         return self._req("GET", "/api/v1/connectors")

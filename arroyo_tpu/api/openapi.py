@@ -51,6 +51,32 @@ JOB = {
         "n_workers": _INT,  # size of the job's running worker set
         # ok | degraded | critical (controller health monitors)
         "health": _STR,
+        # multi-tenant fleet: the tenant keying admission queues/quotas,
+        # and (Queued jobs only) the 1-based admission-queue position
+        "tenant": _STR,
+        "queue_position": _INT,
+    },
+}
+FLEET = {
+    "type": "object",
+    "properties": {
+        # null pool_slots/slots_free = unlimited (fleet pass-through)
+        "pool_slots": _INT,
+        "slots_used": _INT,
+        "slots_free": _INT,
+        # the fleet autoscaler's pool target — for externally sized pools
+        # (node daemons, k8s node pools) this is the scaling knob
+        "target_workers": _INT,
+        "queue_depth": {"type": "object",
+                        "additionalProperties": _INT},
+        "queue": {"type": "array", "items": {
+            "type": "object",
+            "properties": {"job_id": _STR, "tenant": _STR,
+                           "slots": _INT, "position": _INT}}},
+        "tenants": {"type": "object", "additionalProperties": {
+            "type": "object",
+            "properties": {"slots_used": _INT, "jobs_running": _INT,
+                           "queued": _INT}}},
     },
 }
 JOB_EVENT = {
@@ -163,7 +189,9 @@ def spec() -> dict:
                 "post": _op("create_pipeline", "create pipeline + job",
                             body={"type": "object",
                                   "properties": {"name": _STR, "query": _STR,
-                                                 "parallelism": _INT},
+                                                 "parallelism": _INT,
+                                                 # fleet admission/quota key
+                                                 "tenant": _STR},
                                   "required": ["query"]}),
                 "get": _op("list_pipelines", "list pipelines",
                            response={"type": "object",
@@ -237,6 +265,11 @@ def spec() -> dict:
                            "autoscaler's rail state and last decision",
                            ["job_id"],
                            response=JOB_HEALTH)},
+            "/api/v1/fleet": {
+                "get": _op("fleet_status", "multi-tenant fleet snapshot: "
+                           "pool occupancy, per-tenant usage, and the "
+                           "admission queue with positions",
+                           response=FLEET)},
             "/api/v1/connectors": {
                 "get": _op("list_connectors", "available connectors")},
             "/api/v1/connection_profiles": {

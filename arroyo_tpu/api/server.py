@@ -109,6 +109,7 @@ class ApiServer:
         ("GET", r"^/api/v1/jobs/([^/]+)/traces$", "_job_traces"),
         ("GET", r"^/api/v1/jobs/([^/]+)/events$", "_job_events"),
         ("GET", r"^/api/v1/jobs/([^/]+)/health$", "_job_health"),
+        ("GET", r"^/api/v1/fleet$", "_fleet"),
         ("GET", r"^/api/v1/connectors$", "_connectors"),
         ("POST", r"^/api/v1/connection_profiles$", "_create_profile"),
         ("GET", r"^/api/v1/connection_profiles$", "_list_profiles"),
@@ -296,7 +297,9 @@ class ApiServer:
             return
         parallelism = int(body.get("parallelism", 1))
         pid = self.db.create_pipeline(name, query, parallelism)
-        jid = self.db.create_job(pid)
+        # tenant keys the fleet's per-tenant admission queues and quotas
+        jid = self.db.create_job(pid, tenant=str(body.get("tenant")
+                                                 or "default"))
         h._json(200, {"id": pid, "name": name, "job_id": jid})
 
     def _list_pipelines(self, h):
@@ -344,7 +347,26 @@ class ApiServer:
 
     def _get_job(self, h, jid):
         j = self.db.get_job(jid)
-        h._json(200, j) if j else h._json(404, {"error": "not found"})
+        if not j:
+            h._json(404, {"error": "not found"})
+            return
+        if j.get("state") == "Queued":
+            # surface the admission-queue position from the controller's
+            # persisted fleet snapshot (cross-process: the API only has
+            # the DB)
+            pos = self.db.fleet_queue_position(jid)
+            if pos is not None:
+                j["queue_position"] = pos
+        h._json(200, j)
+
+    def _fleet(self, h):
+        """Multi-tenant fleet snapshot (controller/fleet.py): pool size,
+        used/free slots, per-tenant usage + quota queue depth, and the
+        admission queue with positions."""
+        h._json(200, self.db.get_fleet_state() or {
+            "pool_slots": None, "slots_used": 0, "slots_free": None,
+            "target_workers": 0, "queue_depth": {}, "queue": [],
+            "tenants": {}})
 
     def _patch_job(self, h, jid):
         j = self.db.get_job(jid)

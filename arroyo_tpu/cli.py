@@ -541,6 +541,12 @@ def _cmd_top(args) -> int:
             job = db.get_job(args.job_id)
             if job is None:
                 return None, None, None
+            if job.get("state") == "Queued":
+                # admission-queue position from the controller's persisted
+                # fleet snapshot (the API path attaches it server-side)
+                pos = db.fleet_queue_position(args.job_id)
+                if pos is not None:
+                    job["queue_position"] = pos
             return (job, db.get_metrics(args.job_id),
                     db.list_checkpoints(args.job_id))
         base = args.api.rstrip("/")

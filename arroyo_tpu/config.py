@@ -142,6 +142,59 @@ _DEFAULTS: dict[str, Any] = {
         # controller-owned cross-worker checkpoint coordination
         "workers-per-job": 1,
     },
+    "fleet": {
+        # multi-tenant shared worker pool (controller/fleet.py). A job's
+        # slot demand is max(n_workers, parallelism) — one slot per
+        # parallel pipeline lane, at least one per worker process. 0 =
+        # UNLIMITED synthetic pool: admission always grants and the whole
+        # fleet layer is pass-through (the single-tenant default). The
+        # node scheduler derives capacity from registered node daemons'
+        # live /status slots instead when this is 0.
+        "slots": 0,
+        # deficit-round-robin admission: slot credit added per tenant per
+        # dequeue round (larger jobs accumulate credit across rounds, so
+        # a many-small-jobs tenant cannot starve a few-big-jobs tenant)
+        "drr-quantum": 1,
+        # deterministic (no jitter) exponential backoff after a placement
+        # rejection (node 409 / injected admission fault): the job re-
+        # queues at the head of its tenant's queue but is ineligible for
+        # base * 2^(k-1) seconds after its k-th consecutive rejection
+        "requeue-backoff-base-s": 0.5,
+        "requeue-backoff-max-s": 30.0,
+        # per-job supervision-step budget (ControllerServer.tick): a job
+        # whose step overruns it emits JOB_TICK_OVERRUN and is
+        # deprioritized (skipped for up to `tick-penalty-max` ticks, then
+        # always runs again — never starved). 0 disables the budget.
+        "tick-budget-ms": 250,
+        "tick-penalty-max": 4,
+        "quota": {
+            # per-tenant ceilings, applied to EVERY tenant individually
+            # (0 = unlimited); override one tenant via
+            # fleet.quota.tenants.<name>.max-slots / .max-jobs. A job
+            # whose own demand exceeds max-slots is REJECTED (it could
+            # never run); a job that merely pushes current usage past the
+            # quota QUEUES until a peer finishes.
+            "max-slots": 0,
+            "max-jobs": 0,
+        },
+        "autoscale": {
+            # fleet-level elasticity: sustained capacity-blocked queue
+            # demand (or per-job scale-ups the pool could not place)
+            # grows the pool toward demand through the scheduler's
+            # provision hook; synthetic pools (embedded/process) apply
+            # the new size directly, cluster pools surface it as the
+            # arroyo_fleet_target_workers gauge for the node-pool
+            # autoscaler to actuate. Same rails as the per-job loop:
+            # hysteresis, cooldown, clamped bounds.
+            "enabled": False,
+            "max-slots": 64,
+            "up-ticks": 3,
+            "down-ticks": 20,
+            "cooldown-s": 15.0,
+            # free slots to keep above demand after a resize
+            "headroom-slots": 0,
+        },
+    },
     "profile": {
         # runtime cost attribution (obs/profile.py): per-operator self-time
         # accounting in the task run loop, state-size gauges, and key-skew

@@ -113,6 +113,7 @@ class Autoscaler:
         self.in_flight: Optional[int] = None  # target being actuated
         self.last_decision: Optional[dict] = None
         self._last_noop: Optional[tuple] = None  # dedup key for at-bound
+        self._last_blocked: Optional[tuple] = None  # dedup for fleet-block
         self._last_signals: list[dict] = []
 
     # ------------------------------------------------------------ config
@@ -288,6 +289,7 @@ class Autoscaler:
         now = self._clock()
         self._cooldown_until = now + float(self._cfg("cooldown-s", 30.0))
         self._up_ticks = self._down_ticks = 0
+        self._last_blocked = None
         if self.in_flight is not None:
             self.in_flight = None
             if not self._disrupted:
@@ -297,6 +299,29 @@ class Autoscaler:
                 self._failures = 0
                 self._backoff_until = 0.0
         self._disrupted = False
+
+    def on_capacity_blocked(self, parallelism: int, target: int) -> None:
+        """The decided scale-up could not be placed into the fleet's
+        shared capacity (controller/fleet.py ``try_grow`` refused). The
+        decision is abandoned WITHOUT cooldown or disrupted-transition
+        backoff — nothing happened to the worker set — and the pressure
+        hysteresis is re-armed at its threshold so the decision re-fires
+        on the first pressured tick after the fleet grows the pool. The
+        shortfall itself was already noted as fleet pressure by try_grow;
+        this records why the job did not scale."""
+        self.in_flight = None
+        self._disrupted = False
+        self._up_ticks = max(1, int(self._cfg("up-ticks", 3)))
+        key = (parallelism, target)
+        if key == self._last_blocked:
+            return  # the block re-fires every pressured tick; say it once
+        self._last_blocked = key
+        self._emit("WARN", "AUTOSCALE_DECISION",
+                   f"scale up {parallelism} -> {target} blocked by fleet "
+                   "capacity; fleet pressure raised, decision re-arms "
+                   "once the pool grows",
+                   data={"direction": "up", "from": parallelism,
+                         "to": target, "blocked_by": "fleet-capacity"})
 
     def abandon_in_flight(self) -> None:
         """The decided scale never actuated (e.g. a manual rescale request

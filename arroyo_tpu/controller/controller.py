@@ -31,7 +31,8 @@ from ..obs.health import HealthMonitor, health_event_code
 from ..state.tables import latest_complete_checkpoint
 from .autoscaler import Autoscaler
 from .db import Database
-from .scheduler import Scheduler, WorkerHandle, scheduler_for
+from .fleet import FleetManager, demand_slots
+from .scheduler import PlacementFull, Scheduler, WorkerHandle, scheduler_for
 from .states import JobState, check_transition
 
 _log = logging.getLogger("arroyo_tpu.controller")
@@ -41,12 +42,23 @@ class JobController:
     """Supervises one job end-to-end (FSM + running-worker-set control)."""
 
     def __init__(self, db: Database, job_id: str, scheduler: Scheduler,
-                 storage_url: Optional[str] = None):
+                 storage_url: Optional[str] = None,
+                 fleet: Optional[FleetManager] = None):
         self.db = db
         self.job_id = job_id
         self.scheduler = scheduler
         self.storage_url = storage_url or config().get("checkpoint.storage-url")
-        self.state = JobState(self.db.get_job(job_id)["state"])
+        job_row = self.db.get_job(job_id)
+        self.state = JobState(job_row["state"])
+        # multi-tenant fleet (controller/fleet.py): the shared slot pool /
+        # admission queue; a standalone JobController gets its own
+        # (unlimited by default, so the layer is pass-through)
+        self.fleet = fleet if fleet is not None else FleetManager(scheduler)
+        self.tenant = job_row.get("tenant") or "default"
+        self._queued_since: Optional[float] = None
+        # set while a quota-change preemption drains: the stopped set
+        # routes back into the admission queue instead of Stopped
+        self._requeue_after_stop = False
         # the job's worker set; a finished worker's slot goes None until the
         # whole set drains (index == worker_index for assignment/commit fan-out)
         self.handles: list[Optional[WorkerHandle]] = []
@@ -104,6 +116,28 @@ class JobController:
         # (job, seq) row and be silently dropped by the idempotent flush
         self._events_flushed_seq = self.db.last_event_seq(job_id)
         events_recorder.ensure_seq_floor(job_id, self._events_flushed_seq)
+        if self.state not in (JobState.CREATED, JobState.COMPILING,
+                              JobState.QUEUED, JobState.RESTARTING) \
+                and not self.is_terminal():
+            # fresh controller adopting a LIVE job: the fleet ledger must
+            # reflect its slots even if that briefly oversubscribes the
+            # pool (free clamps at zero; pressure drains the overdraft).
+            # RESTARTING is excluded: it is only entered from a terminal
+            # state whose slots were released — a manual restart must
+            # re-enter admission (the _step_inner restart path), not
+            # adopt its way past a full pool and the tenant quota.
+            pipeline = self.db.get_pipeline(job_row["pipeline_id"]) or {}
+            par = int(job_row.get("desired_parallelism")
+                      or pipeline.get("parallelism") or 1)
+            self.fleet.adopt(self.job_id, self.tenant, demand_slots(
+                int(job_row.get("n_workers") or 1), par))
+
+    def _demand(self) -> int:
+        """This job's slot demand: one slot per parallel lane, at least
+        one per worker of its set (see fleet.demand_slots)."""
+        return demand_slots(
+            int(config().get("controller.workers-per-job") or 1),
+            self.parallelism)
 
     def _event(self, level: str, code: str, message: str, **kw) -> None:
         events_recorder.record(self.job_id, level, code, message, **kw)
@@ -199,6 +233,13 @@ class JobController:
     def step(self) -> None:
         """One supervision tick; cheap and non-blocking."""
         try:
+            # chaos site `job_tick` (ctx: key=job id): delay=MS models a
+            # melting job's slow supervision step (storage stall, wedged
+            # drain) — the tick budget must detect and deprioritize it
+            # while its neighbors keep their heartbeat/watchdog cadence
+            from ..faults import fault_point
+
+            fault_point("job_tick", key=self.job_id)
             self._step_inner()
         except Exception:  # noqa: BLE001 - job failure, not controller crash
             self.failure = traceback.format_exc()
@@ -233,6 +274,8 @@ class JobController:
             self._set_state(JobState.COMPILING)
         elif self.state == JobState.COMPILING:
             self._compile(job)
+        elif self.state == JobState.QUEUED:
+            self._queued_tick(job)
         elif self.state == JobState.SCHEDULING:
             self._schedule(job)
         elif self.state in (JobState.RUNNING, JobState.CHECKPOINT_STOPPING,
@@ -252,6 +295,12 @@ class JobController:
             restarts_allowed = config().get("pipeline.allowed-restarts")
             if self.state == JobState.RECOVERING and self.restarts > restarts_allowed:
                 self._fail(f"exceeded allowed-restarts={restarts_allowed}: {self.failure}")
+                return
+            # a crash-restoring job still holds its fleet slots; a restart
+            # of a TERMINAL job released them and must re-enter admission
+            # (Queued when the shared pool or its tenant quota is full)
+            if not self.fleet.holds(self.job_id) \
+                    and not self._admit_or_queue(job):
                 return
             self.restore_epoch = latest_complete_checkpoint(self.storage_url, self.job_id)
             self._event("WARN", "RESTORE",
@@ -284,6 +333,9 @@ class JobController:
             # conditional clear: a request racing in after the re-read
             # above survives and triggers a follow-up rescale
             self.db.clear_desired_parallelism(self.job_id, int(target))
+        # the transition is over: the ledger settles on the final demand
+        # (a scale-down frees slots for the next admission pass)
+        self.fleet.set_demand(self.job_id, self._demand())
         self.restore_epoch = latest_complete_checkpoint(self.storage_url, self.job_id)
         self._event("WARN", "RESTORE",
                     f"restoring worker set from epoch "
@@ -315,7 +367,109 @@ class JobController:
         # validate with registered connection tables in scope; workers get
         # the planned IR (graph_json) so they need no DB access
         plan_query(self.sql, connection_tables=self.db.list_connection_tables())
+        if not self._admit_or_queue(job):
+            return
         self._set_state(JobState.SCHEDULING)
+
+    def _hydrate_from_pipeline(self, job: dict) -> bool:
+        """Load sql/parallelism for a job this controller never compiled
+        (fresh controller adopting a Restarting/Recovering/Queued job)."""
+        if self.sql is not None:
+            return True
+        pipeline = self.db.get_pipeline(job["pipeline_id"])
+        if pipeline is None:
+            self._fail("pipeline deleted")
+            return False
+        self.sql = pipeline["query"]
+        self.parallelism = int(job.get("desired_parallelism")
+                               or pipeline["parallelism"])
+        self.restarts = int(job.get("restarts") or 0)
+        return True
+
+    def _admit_or_queue(self, job: dict) -> bool:
+        """Ask the fleet for this job's slots. True = admitted, proceed;
+        False = the state already moved (Queued on full pool / tenant at
+        quota, Failed on a structural quota rejection)."""
+        if not self._hydrate_from_pipeline(job):
+            return False
+        slots = self._demand()
+        verdict, reason = self.fleet.admit(self.job_id, self.tenant, slots)
+        data = {"tenant": self.tenant, "slots": slots, "reason": reason}
+        if verdict == "rejected":
+            self._event("ERROR", "JOB_REJECTED",
+                        f"admission rejected: {reason}", data=data)
+            self._fail(f"admission rejected: {reason}")
+            return False
+        if verdict == "queued":
+            self._queued_since = time.monotonic()
+            self._event("INFO", "JOB_QUEUED",
+                        f"waiting for admission: {reason}", data=data)
+            self._set_state(JobState.QUEUED)
+            return False
+        if self.fleet.pool_slots() is not None:
+            # decision-point visibility only when the fleet is bounded —
+            # the unlimited pass-through default stays event-silent
+            self._event("INFO", "JOB_ADMITTED",
+                        f"admitted into shared capacity ({slots} slots)",
+                        data=data)
+        return True
+
+    def _queued_tick(self, job: dict) -> None:
+        """One supervision tick in QUEUED: react to a cancel, otherwise
+        wait for the fleet's deficit-round-robin pass to grant the slots
+        (capacity freed by any terminal job triggers re-admission on the
+        next tick)."""
+        if not self._hydrate_from_pipeline(job):
+            return
+        if job.get("desired_stop"):
+            # cancel path: nothing is running, stop takes effect now
+            self.fleet.release(self.job_id)
+            self._event("INFO", "JOB_QUEUED",
+                        "queued job cancelled by a stop request")
+            self._set_state(JobState.STOPPED)
+            return
+        if not self.fleet.holds(self.job_id) \
+                and self.fleet.queue_position(self.job_id) is None:
+            # adopted mid-queue by a fresh controller whose fleet ledger
+            # is empty: re-enter at the PERSISTED position, so N adopted
+            # jobs restore the original FIFO order regardless of which
+            # controller ticks first
+            self.fleet.restore_queued(
+                self.job_id, self.tenant, self._demand(),
+                position=self.db.fleet_queue_position(self.job_id))
+        if not self.fleet.should_admit(self.job_id):
+            return
+        waited = (time.monotonic() - self._queued_since
+                  if self._queued_since is not None else 0.0)
+        self._event("INFO", "JOB_ADMITTED",
+                    f"admitted after {waited:.1f}s queued "
+                    f"({self._demand()} slots)",
+                    data={"tenant": self.tenant, "slots": self._demand(),
+                          "waited_s": round(waited, 3)})
+        self.fleet.clear_backoff(self.job_id)
+        # a preempted (or 409-bounced) job resumes from its freshest
+        # checkpoint; a first-time job has none and starts clean
+        self.restore_epoch = latest_complete_checkpoint(
+            self.storage_url, self.job_id)
+        self._set_state(JobState.SCHEDULING,
+                        restore_epoch=self.restore_epoch)
+
+    def _requeue_for_capacity(self, reason: str) -> None:
+        """Placement was rejected on capacity (node-daemon 409, injected
+        admission fault): tear down whatever partially placed, re-queue at
+        the head of the tenant queue with deterministic backoff — never a
+        job failure, never a restart-budget token."""
+        self._kill_all()
+        self.fleet.requeue(self.job_id, self.tenant, self._demand(),
+                           backoff=True)
+        self._queued_since = time.monotonic()
+        backoff = self.fleet.backoff_remaining(self.job_id)
+        self._event("WARN", "JOB_QUEUED",
+                    f"placement rejected; re-queued with {backoff:.1f}s "
+                    f"backoff: {reason.splitlines()[0][:200]}",
+                    data={"tenant": self.tenant, "slots": self._demand(),
+                          "backoff_s": round(backoff, 3), "reason": "409"})
+        self._set_state(JobState.QUEUED)
 
     def _compile_graph(self):
         """Plan once in the control plane and ship the dataflow IR to
@@ -352,11 +506,23 @@ class JobController:
             self.restarts = int(job["restarts"])
         graph_json = self._compile_graph()
         n_workers = int(config().get("controller.workers-per-job") or 1)
-        self.handles = list(self.scheduler.start_workers(
-            self.sql, self.job_id, self.parallelism, self.restore_epoch,
-            self.storage_url, udf_specs=self.db.list_udfs(),
-            graph_json=graph_json, n_workers=n_workers,
-        ))
+        from ..faults import InjectedFault, fault_point
+
+        try:
+            # chaos site `admission`: a node 409 (or delay) at the exact
+            # placement moment, injectable for every scheduler. Recovery
+            # is re-queue with deterministic backoff, never job failure.
+            fault_point("admission", key=self.job_id, job=self.job_id)
+            self.handles = list(self.scheduler.start_workers(
+                self.sql, self.job_id, self.parallelism, self.restore_epoch,
+                self.storage_url, udf_specs=self.db.list_udfs(),
+                graph_json=graph_json, n_workers=n_workers,
+            ))
+        except (PlacementFull, InjectedFault) as e:
+            self._requeue_for_capacity(str(e))
+            return
+        # a placement landed: the consecutive-409 backoff streak resets
+        self.fleet.clear_backoff(self.job_id)
         self.coordinator = None
         if len(self.handles) > 1:
             # multi-worker set: this controller owns checkpoint coordination
@@ -547,11 +713,28 @@ class JobController:
             self._finish_rescale(job)
             return True
         if self.state in (JobState.STOPPING, JobState.CHECKPOINT_STOPPING):
-            self._set_state(JobState.STOPPED)
+            if self._requeue_after_stop:
+                self._finish_preemption()
+            else:
+                self._set_state(JobState.STOPPED)
         else:
             self._set_state(JobState.FINISHING)
             self._set_state(JobState.FINISHED)
         return True
+
+    def _finish_preemption(self) -> None:
+        """A quota-change preemption finished draining: back into the
+        admission queue (no backoff — nothing was rejected), resuming from
+        the drain checkpoint once the tenant fits its quota again."""
+        self._requeue_after_stop = False
+        self.fleet.requeue(self.job_id, self.tenant, self._demand())
+        self._queued_since = time.monotonic()
+        self._event("INFO", "JOB_QUEUED",
+                    "preempted worker set drained; job re-entered the "
+                    "admission queue",
+                    data={"tenant": self.tenant, "slots": self._demand(),
+                          "reason": "preempted"})
+        self._set_state(JobState.QUEUED)
 
     def _on_worker_failed(self, error: str, job: dict,
                           worker: Optional[int] = None) -> None:
@@ -575,7 +758,13 @@ class JobController:
             self.autoscaler.on_scale_disrupted(error or "worker failure")
             self._finish_rescale(job)
         elif self.state in (JobState.STOPPING, JobState.CHECKPOINT_STOPPING):
-            self._set_state(JobState.STOPPED)
+            if self._requeue_after_stop:
+                # the preemption drain died mid-flight; the job still
+                # re-queues and will restore from its last complete
+                # checkpoint when re-admitted
+                self._finish_preemption()
+            else:
+                self._set_state(JobState.STOPPED)
         else:
             self._set_state(JobState.RECOVERING,
                             failure_message=(self.failure or "")[-4000:])
@@ -717,9 +906,23 @@ class JobController:
                         return
                     break  # slot emptied; finished is a worker's last event
                 elif kind == "failed":
-                    self._on_worker_failed(
-                        ev.get("error", "unknown worker failure"), job,
-                        worker=widx)
+                    err = ev.get("error", "unknown worker failure")
+                    from .scheduler import NodeScheduler
+
+                    if err.startswith("placement failed") \
+                            and self.state == JobState.RUNNING \
+                            and NodeScheduler._capacity_reason(err):
+                        # a deferred (lazy) node placement timed out on
+                        # CAPACITY (409 / no free slots / no daemons):
+                        # the job never actually ran — re-queue with
+                        # backoff instead of burning a restart-budget
+                        # token through _on_worker_failed. Hard placement
+                        # errors (a daemon answering 500) still take the
+                        # normal failure path so the restart budget can
+                        # cap a persistent misconfiguration.
+                        self._requeue_for_capacity(err)
+                        return
+                    self._on_worker_failed(err, job, worker=widx)
                     return
 
         # health monitors: every supervision tick evaluates the rule set
@@ -761,6 +964,26 @@ class JobController:
                 if h is not None:
                     h.stop()
 
+        # quota-change preemption: the fleet marked this job (its tenant's
+        # quota dropped below current usage) — drain behind a final
+        # checkpoint, then back into the admission queue (JOB_PREEMPTED ->
+        # drained -> JOB_QUEUED), restoring from that checkpoint once the
+        # tenant fits again
+        if self.state == JobState.RUNNING \
+                and self.fleet.take_preemption(self.job_id):
+            self._event("WARN", "JOB_PREEMPTED",
+                        f"tenant {self.tenant!r} over quota after a quota "
+                        "change; draining behind a final checkpoint and "
+                        "re-queueing",
+                        data={"tenant": self.tenant,
+                              "slots": self._demand()})
+            self._requeue_after_stop = True
+            self.stopping_epoch = self.next_epoch
+            self.next_epoch += 1
+            self._trigger_checkpoint(self.stopping_epoch, then_stop=True)
+            self._set_state(JobState.CHECKPOINT_STOPPING)
+            return
+
         # elastic autoscaler: sustained pressure (or proven headroom) on
         # the merged metrics becomes a desired_parallelism the rescale
         # block below actuates through the normal drain/restore path. A
@@ -773,6 +996,16 @@ class JobController:
             self._last_merged_metrics if can_scale else None,
             running=can_scale, parallelism=self.parallelism,
             ckpt_failures=self._ckpt_failures)
+        if target is not None and target > self.parallelism:
+            # a scale-up needs extra fleet slots BEFORE it actuates: a
+            # pool that cannot place it turns the decision into fleet
+            # pressure (the fleet loop grows the pool; the re-armed
+            # hysteresis re-fires the decision once it has) instead of a
+            # doomed drain/restore cycle
+            grow = demand_slots(len(self.handles) or 1, target)
+            if not self.fleet.try_grow(self.job_id, grow):
+                self.autoscaler.on_capacity_blocked(self.parallelism, target)
+                target = None
         if target is not None:
             # compare-and-set: a manual PATCH landing between this tick's
             # job-row read and here must win, not be clobbered
@@ -795,6 +1028,13 @@ class JobController:
             want = job.get("desired_parallelism")
             if want and int(want) != self.parallelism:
                 self.rescale_to = int(want)
+                # the fleet ledger carries the transition's worst case
+                # (old lanes still live while the drain runs); manual
+                # requests always win even if that oversubscribes — the
+                # overdraft reads as fleet pressure and grows the pool
+                self.fleet.set_demand(self.job_id, demand_slots(
+                    len(self.handles) or 1,
+                    max(self.parallelism, int(want))))
                 self._event("INFO", "RESCALE",
                             f"rescale {self.parallelism} -> {int(want)}: "
                             "draining the set behind a final checkpoint",
@@ -844,6 +1084,17 @@ class ControllerServer:
         self.storage_url = storage_url
         self.poll_interval = poll_interval
         self.jobs: dict[str, JobController] = {}
+        # the multi-tenant fleet: one shared slot pool / admission queue
+        # across every job this controller supervises
+        self.fleet = FleetManager(self.scheduler)
+        # per-job tick isolation: a job whose supervision step overruns
+        # fleet.tick-budget-ms is deprioritized (runs last, skipped for
+        # up to tick-penalty-max ticks) so a melting job cannot starve
+        # its neighbors' heartbeat/watchdog checks — but it always runs
+        # again, never skipped forever
+        self._tick_penalty: dict[str, int] = {}
+        self._tick_skip: dict[str, int] = {}
+        self._overrun_emitted: dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -857,6 +1108,14 @@ class ControllerServer:
             self.tick()
             self._stop.wait(self.poll_interval)
 
+    # supervision states the per-job tick budget applies to: compile and
+    # schedule steps are EXPECTED to be slow (planning, spawning worker
+    # sets) — the isolation target is a melting RUNNING job stalling its
+    # neighbors' heartbeat/watchdog checks
+    _BUDGETED_STATES = (JobState.RUNNING, JobState.CHECKPOINT_STOPPING,
+                        JobState.STOPPING, JobState.FINISHING,
+                        JobState.RESCALING)
+
     def tick(self) -> None:
         for row in self.db.list_jobs():
             jid = row["id"]
@@ -864,7 +1123,8 @@ class ControllerServer:
                 if row["state"] in ("Failed", "Finished", "Stopped"):
                     continue
                 self.jobs[jid] = JobController(
-                    self.db, jid, self.scheduler, self.storage_url
+                    self.db, jid, self.scheduler, self.storage_url,
+                    fleet=self.fleet,
                 )
         for jid, jc in list(self.jobs.items()):
             if jc.is_terminal():
@@ -889,9 +1149,57 @@ class ControllerServer:
                 # copy is the postmortem surface)
                 jc._flush_events()
                 events_recorder.clear_job(jid)
+                # freed capacity is handed out by this tick's admission
+                # pass below — any terminal job triggers re-admission
+                self.fleet.release(jid)
+                self._tick_penalty.pop(jid, None)
+                self._tick_skip.pop(jid, None)
+                self._overrun_emitted.pop(jid, None)
                 del self.jobs[jid]
                 continue
+        # fleet pass BEFORE job steps: capacity refresh, quota-preemption
+        # marks, the DRR admission pass over freshly freed slots, the
+        # fleet autoscaler, gauge export, and the persisted snapshot
+        self.fleet.tick(self.db)
+        budget_ms = float(config().get("fleet.tick-budget-ms") or 0)
+        pen_max = max(1, int(config().get("fleet.tick-penalty-max") or 4))
+        # deprioritized jobs run LAST so a melting job's slow step lands
+        # after its neighbors already got their heartbeat/watchdog ticks
+        ordered = sorted(self.jobs.items(),
+                         key=lambda kv: self._tick_penalty.get(kv[0], 0))
+        for jid, jc in ordered:
+            if jc.is_terminal():
+                continue  # cleaned up at the top of the next tick
+            skip = self._tick_skip.get(jid, 0)
+            if skip > 0:
+                self._tick_skip[jid] = skip - 1
+                continue
+            budgeted = budget_ms > 0 and jc.state in self._BUDGETED_STATES
+            t0 = time.monotonic()
             jc.step()
+            dt_ms = (time.monotonic() - t0) * 1000.0
+            if budgeted and dt_ms > budget_ms:
+                pen = min(self._tick_penalty.get(jid, 0) + 1, pen_max)
+                self._tick_penalty[jid] = pen
+                self._tick_skip[jid] = pen
+                now = time.monotonic()
+                if now - self._overrun_emitted.get(jid, 0.0) >= 5.0:
+                    self._overrun_emitted[jid] = now
+                    jc._event(
+                        "WARN", "JOB_TICK_OVERRUN",
+                        f"supervision step took {dt_ms:.0f}ms (budget "
+                        f"{budget_ms:.0f}ms); deprioritized for {pen} "
+                        "ticks — neighbors tick first, this job still "
+                        "ticks every cycle after that",
+                        data={"ms": round(dt_ms, 1),
+                              "budget_ms": budget_ms, "penalty": pen})
+            elif self._tick_penalty.get(jid):
+                # a compliant step decays the penalty toward zero
+                pen = self._tick_penalty[jid] - 1
+                if pen:
+                    self._tick_penalty[jid] = pen
+                else:
+                    self._tick_penalty.pop(jid, None)
 
     def stop(self) -> None:
         self._stop.set()

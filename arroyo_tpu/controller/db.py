@@ -121,6 +121,11 @@ CREATE TABLE IF NOT EXISTS job_health (
     data TEXT NOT NULL,           -- JSON per-rule detail (obs.health)
     updated_at REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS fleet_state (
+    id INTEGER PRIMARY KEY CHECK (id = 1),  -- singleton snapshot row
+    data TEXT NOT NULL,           -- JSON (controller/fleet.py stats())
+    updated_at REAL NOT NULL
+);
 """
 
 _OUTPUT_CAP = 10_000  # preview rows retained per job
@@ -140,6 +145,7 @@ class Database:
                 "ALTER TABLE jobs ADD COLUMN desired_parallelism INTEGER",
                 "ALTER TABLE jobs ADD COLUMN n_workers INTEGER NOT NULL DEFAULT 1",
                 "ALTER TABLE jobs ADD COLUMN health TEXT",
+                "ALTER TABLE jobs ADD COLUMN tenant TEXT NOT NULL DEFAULT 'default'",
                 "ALTER TABLE checkpoints ADD COLUMN phases TEXT",
             ):
                 try:
@@ -189,12 +195,16 @@ class Database:
 
     # ----------------------------------------------------------------- jobs
 
-    def create_job(self, pipeline_id: str) -> str:
+    def create_job(self, pipeline_id: str, tenant: str = "default") -> str:
+        """``tenant`` keys the fleet's per-tenant admission queues and
+        quotas (controller/fleet.py)."""
         jid = f"job_{uuid.uuid4().hex[:12]}"
         with self._lock:
             self._conn.execute(
-                "INSERT INTO jobs (id, pipeline_id, state, updated_at) VALUES (?,?,?,?)",
-                (jid, pipeline_id, "Created", time.time()),
+                "INSERT INTO jobs (id, pipeline_id, state, tenant, "
+                "updated_at) VALUES (?,?,?,?,?)",
+                (jid, pipeline_id, "Created", tenant or "default",
+                 time.time()),
             )
             self._conn.commit()
         return jid
@@ -583,6 +593,41 @@ class Database:
         out["state"] = row["state"]
         out["updated_at"] = row["updated_at"]
         return out
+
+    def record_fleet_state(self, data: dict) -> None:
+        """Latest fleet snapshot (controller/fleet.py stats(): pool size,
+        used/free slots, per-tenant usage, the admission queue with
+        positions) — what GET /api/v1/fleet and queued jobs' API queue
+        positions serve, cross-process."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO fleet_state (id, data, updated_at) "
+                "VALUES (1,?,?) ON CONFLICT(id) DO UPDATE SET "
+                "data=excluded.data, updated_at=excluded.updated_at",
+                (json.dumps(data), time.time()),
+            )
+            self._conn.commit()
+
+    def get_fleet_state(self) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT data, updated_at FROM fleet_state WHERE id=1"
+            ).fetchone()
+        if row is None:
+            return None
+        out = json.loads(row["data"])
+        out["updated_at"] = row["updated_at"]
+        return out
+
+    def fleet_queue_position(self, job_id: str) -> Optional[int]:
+        """1-based admission-queue position of a Queued job, from the
+        persisted fleet snapshot — the one lookup both the jobs API and
+        `top --db` attach to queued job rows."""
+        fleet = self.get_fleet_state() or {}
+        for e in fleet.get("queue") or []:
+            if e.get("job_id") == job_id:
+                return e.get("position")
+        return None
 
     def record_profile(self, job_id: str, data: dict) -> None:
         """Latest compact per-operator cost profile (obs.profile.job_profile

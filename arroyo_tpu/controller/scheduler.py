@@ -39,6 +39,14 @@ import time
 from typing import Optional
 
 
+class PlacementFull(RuntimeError):
+    """The cluster has no free capacity for a placement (node-daemon 409,
+    no daemon with free slots, or no daemons registered at all). The
+    controller treats this as retriable: the job re-queues into the fleet's
+    admission queue with deterministic backoff — it is never failed and
+    never burns a restart-budget token."""
+
+
 class WorkerHandle:
     """One running worker of a job (a job's dataflow runs on one or more)."""
 
@@ -351,8 +359,23 @@ class Scheduler:
         return [self.start_worker(sql, job_id, parallelism, restore_epoch,
                                   storage_url, udf_specs, graph_json)]
 
+    def provision_slots(self, target: int) -> Optional[int]:
+        """Fleet-elasticity hook (controller/fleet.py): asked to resize
+        the worker pool to ``target`` slots. Schedulers whose pool is a
+        synthetic budget (embedded/process) return the accepted size; a
+        scheduler whose pool is sized externally (node daemons joining a
+        cluster, a kubernetes node pool) returns None — the fleet then
+        only moves the ``arroyo_fleet_target_workers`` gauge, which is
+        the knob an external node-pool autoscaler actuates."""
+        return None
+
 
 class EmbeddedScheduler(Scheduler):
+    def provision_slots(self, target):
+        # synthetic pool: in-process workers have no physical node budget,
+        # so the fleet's resize is accepted as-is
+        return int(target)
+
     def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
                      udf_specs=None, graph_json=None):
         if udf_specs:
@@ -397,6 +420,10 @@ class EmbeddedScheduler(Scheduler):
 
 
 class ProcessScheduler(Scheduler):
+    def provision_slots(self, target):
+        # synthetic pool (subprocesses on one machine): accepted as-is
+        return int(target)
+
     def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
                      udf_specs=None, graph_json=None):
         return ProcessWorkerHandle(sql, job_id, parallelism, restore_epoch, storage_url,
@@ -643,9 +670,14 @@ class NodeScheduler(Scheduler):
         self.db = db
 
     def _place_once(self, args: tuple, last: str, **multi_kw):
-        """One placement sweep over live daemons -> (handle|None, reason)."""
+        """One placement sweep over live daemons -> (handle|None, reason).
+        A 409 (the daemon's hard slot limit — its status poll races other
+        placements) reads as a capacity rejection, which the controller
+        answers by re-queueing the job into the fleet's admission queue
+        with backoff, never by failing it."""
         import urllib.error
 
+        from ..faults import InjectedFault, fault_point
         from .node import _get
 
         nodes = self.db.list_nodes(alive_within_s=10.0)
@@ -661,14 +693,29 @@ class NodeScheduler(Scheduler):
         candidates.sort(key=lambda fn: -fn[0])
         for _free, n in candidates:
             try:
+                # chaos site `admission`: a node 409 (or a slow admission
+                # RPC) at the exact placement moment — fail models the
+                # daemon rejecting after the status poll said free
+                fault_point("admission", key=str(n["id"]),
+                            job=str(args[1]) if len(args) > 1 else "")
                 return NodeWorkerHandle(n["addr"], *args, **multi_kw), last
+            except InjectedFault:
+                last = f"node {n['id']} full (409, injected)"
             except urllib.error.HTTPError as e:
-                last = f"node {n['id']} rejected placement: {e}"
+                if e.code == 409:
+                    last = f"node {n['id']} full (409)"
+                else:
+                    last = f"node {n['id']} rejected placement: {e}"
             except OSError as e:
                 last = f"node {n['id']} unreachable: {e}"
         if nodes and not candidates:
             last = "no node daemon with free slots"
         return None, last
+
+    @staticmethod
+    def _capacity_reason(last: str) -> bool:
+        return ("full (409" in last or "free slots" in last
+                or "no live node daemons" in last)
 
     def start_worker(self, sql, job_id, parallelism, restore_epoch, storage_url=None,
                      udf_specs=None, graph_json=None,
@@ -720,6 +767,12 @@ class NodeScheduler(Scheduler):
                         handles.append(h)
                         break
                     if time.monotonic() > deadline:
+                        if self._capacity_reason(last):
+                            # capacity, not a hard error: the controller
+                            # re-queues the job instead of failing it
+                            raise PlacementFull(
+                                f"placed {i}/{n} workers of job {job_id}: "
+                                f"{last}")
                         raise RuntimeError(
                             f"placed {i}/{n} workers of job {job_id}: {last}")
                     time.sleep(0.25)

@@ -67,6 +67,22 @@ Instrumented sites:
     spill_compact       spill-generation merge write (ctx: key, epoch,
                         subtask): a failure keeps the old generations —
                         more read amplification, zero correctness impact
+    admission           worker placement for a job (controller _schedule +
+                        NodeScheduler._place_once; ctx: key/job): fail
+                        models a node-daemon 409 after the status poll
+                        said free — the job re-queues into the fleet's
+                        admission queue with deterministic backoff, NEVER
+                        fails; delay models a slow admission RPC
+    fleet_place         the fleet's per-job placement decision inside the
+                        deficit-round-robin admission pass (ctx: key=job,
+                        tenant, slots): drop suppresses the grant for the
+                        pass, force grants regardless of credit/capacity
+                        (the rails must absorb the oversubscription)
+    job_tick            a job's controller supervision step (ctx: key=job):
+                        delay=MS models a melting job's slow step — the
+                        fleet.tick-budget-ms isolation must emit
+                        JOB_TICK_OVERRUN and deprioritize it while its
+                        neighbors keep their heartbeat/watchdog cadence
 """
 
 from __future__ import annotations
@@ -97,6 +113,7 @@ SITES = (
     "connector.poll", "connector.commit", "worker", "worker.heartbeat",
     "node.start_worker", "controller_rpc", "commit", "rescale",
     "autoscale_decide", "spill_write", "spill_probe", "spill_compact",
+    "admission", "fleet_place", "job_tick",
 )
 
 

@@ -211,6 +211,9 @@ class MetricsRegistry:
         # (segment, schema, padded-shape)), and the compile-cache hit count
         self._segment_compile: dict[str, Histogram] = {}
         self._segment_cache_hits: dict[str, int] = {}
+        # multi-tenant fleet snapshot (controller/fleet.py stats()), set
+        # once per ControllerServer tick; None until a fleet pass ran
+        self._fleet: Optional[dict] = None
 
     def set_job_health(self, job_id: str, state: str) -> None:
         with self._lock:
@@ -219,6 +222,10 @@ class MetricsRegistry:
     def set_autoscaler_target(self, job_id: str, target: int) -> None:
         with self._lock:
             self._autoscaler_target[job_id] = int(target)
+
+    def set_fleet_stats(self, stats: Optional[dict]) -> None:
+        with self._lock:
+            self._fleet = stats
 
     def task(self, job_id: str, node_id: str, subtask: int) -> TaskMetrics:
         key = (job_id, node_id, subtask)
@@ -431,6 +438,38 @@ class MetricsRegistry:
             for job, target in autoscaler_targets:
                 lines.append(
                     f'arroyo_autoscaler_target{{job="{job}"}} {target}')
+        # multi-tenant fleet: slot occupancy, per-tenant admission-queue
+        # depth, and the fleet autoscaler's pool target. Slot/target
+        # series only export for a BOUNDED pool (an unlimited pass-through
+        # fleet has no meaningful occupancy number); queue depth exports
+        # whenever jobs are queued.
+        with self._lock:
+            fleet = self._fleet
+        if fleet is not None:
+            if fleet.get("pool_slots") is not None:
+                lines.append("# TYPE arroyo_fleet_slots gauge")
+                lines.append(
+                    f'arroyo_fleet_slots{{state="used"}} '
+                    f"{int(fleet.get('slots_used') or 0)}")
+                lines.append(
+                    f'arroyo_fleet_slots{{state="free"}} '
+                    f"{int(fleet.get('slots_free') or 0)}")
+                lines.append("# TYPE arroyo_fleet_target_workers gauge")
+                lines.append(
+                    f"arroyo_fleet_target_workers "
+                    f"{int(fleet.get('target_workers') or 0)}")
+            depth = fleet.get("queue_depth") or {}
+            if depth:
+                lines.append("# TYPE arroyo_fleet_queue_depth gauge")
+                for tenant, n in sorted(depth.items()):
+                    # tenant is the one FREE-TEXT (user-supplied) label in
+                    # this exposition: escape per the text format or a
+                    # quote/newline in a tenant name corrupts the whole
+                    # scrape
+                    esc = (str(tenant).replace("\\", "\\\\")
+                           .replace('"', '\\"').replace("\n", "\\n"))
+                    lines.append(
+                        f'arroyo_fleet_queue_depth{{tenant="{esc}"}} {n}')
         from .obs.events import recorder as _events_recorder
 
         counts = _events_recorder.counts_snapshot()

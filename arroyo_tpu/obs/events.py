@@ -86,6 +86,30 @@ EVENT_CODES: dict[str, tuple[str, str]] = {
         "WARN", "a marked segment could not trace (or its first-batch "
                 "verification diverged) and degraded to the interpreted "
                 "per-operator path for this run; data carries the reason"),
+    "JOB_QUEUED": (
+        "INFO", "the fleet could not place the job (pool full / tenant at "
+                "quota / placement 409'd) — it waits in its tenant's FIFO "
+                "admission queue instead of failing (data: tenant, slots, "
+                "reason; a 409 re-queue carries its deterministic "
+                "backoff_s and is emitted at WARN)"),
+    "JOB_ADMITTED": (
+        "INFO", "the fleet's deficit-round-robin pass granted the job's "
+                "slots; it proceeds to Scheduling (data: tenant, slots, "
+                "waited_s when it queued first)"),
+    "JOB_REJECTED": (
+        "ERROR", "admission rejected structurally: the job's own demand "
+                 "exceeds its tenant's max-slots quota, so it could never "
+                 "run — the one admission verdict that fails the job"),
+    "JOB_PREEMPTED": (
+        "WARN", "a quota change left the tenant over its slot budget; the "
+                "fleet preempts the tenant's newest job — drain behind a "
+                "final checkpoint, then back into the admission queue"),
+    "JOB_TICK_OVERRUN": (
+        "WARN", "the job's supervision step overran fleet.tick-budget-ms; "
+                "it is deprioritized (neighbors tick first, this job is "
+                "skipped for `penalty` ticks then always runs again) so a "
+                "melting job cannot starve its neighbors' heartbeat/"
+                "watchdog checks (data: ms, budget_ms, penalty)"),
     "SPILL_STARTED": (
         "INFO", "tiered state engaged: a subtask's resident state passed "
                 "its budget and cold partitions began spilling to storage "

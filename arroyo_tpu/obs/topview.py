@@ -55,6 +55,15 @@ def render(job: dict, metrics: Optional[dict],
             f"workers={job.get('n_workers', 1)}  "
             f"restarts={job.get('restarts', 0)}  "
             f"epoch={job.get('checkpoint_epoch', 0)}")
+    tenant = job.get("tenant")
+    if tenant and tenant != "default":
+        head += f"  tenant={tenant}"
+    if job.get("state") == "Queued":
+        # multi-tenant fleet: the job waits in its tenant's admission
+        # queue; the position comes from the persisted fleet snapshot
+        pos = job.get("queue_position")
+        head += ("  queue_pos=" + (str(pos) if pos else "?"))
+        return head + "\n  (queued for fleet admission; no worker set yet)"
     if not metrics:
         return head + "\n  (no metrics snapshot yet)"
     rows: list[tuple[str, ...]] = []

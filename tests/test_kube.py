@@ -195,5 +195,15 @@ def test_manifest_probes_and_autoscaler_keys():
     assert "/status" in text
     assert "ARROYO_TPU__AUTOSCALER__ENABLED" in text
     assert "ARROYO_TPU__AUTOSCALER__MAX_PARALLELISM" in text
+    # multi-tenant fleet: per-tenant quotas, the per-job supervision tick
+    # budget, and the node-pool scaling knob (fleet elasticity) must ride
+    # the control-plane deployment
+    assert "ARROYO_TPU__FLEET__QUOTA__MAX_SLOTS" in text
+    assert "ARROYO_TPU__FLEET__TICK_BUDGET_MS" in text
+    assert "ARROYO_TPU__FLEET__AUTOSCALE__ENABLED" in text
+    assert "arroyo_fleet_target_workers" in text, (
+        "the manifest must name the gauge an external node-pool "
+        "autoscaler keys off")
     readme = os.path.join(os.path.dirname(path), "README.md")
     assert os.path.exists(readme)
+    assert "Multi-tenant fleet" in open(readme).read()

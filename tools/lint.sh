@@ -64,6 +64,7 @@ NAME_RE = re.compile(r"arroyo_(?:worker|checkpoint)_[a-z0-9_]+"
                      r"|arroyo_autoscaler_[a-z0-9_]+"
                      r"|arroyo_segment_[a-z0-9_]+"
                      r"|arroyo_spill_[a-z0-9_]+"
+                     r"|arroyo_fleet_[a-z0-9_]+"
                      r"|arroyo_events_total")
 code_names: set[str] = set()
 for p in glob.glob("arroyo_tpu/**/*.py", recursive=True):
