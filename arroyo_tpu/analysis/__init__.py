@@ -7,6 +7,12 @@ Two engines share one diagnostic model (``diagnostics.Diagnostic``):
   ``python -m arroyo_tpu check <pipeline.sql>``. ERROR findings reject the
   pipeline at plan time — before state allocation or device compilation —
   matching the reference planner's ``--fail`` SQL tests.
+- **Plan-diff pass** (``plan_diff``, AR010-012): live-evolution safety —
+  matches operators across an old and new plan by stable state identity
+  (node lineage + declared TableSpecs + key/window/aggregate config) and
+  classifies each as carried, rebuilt-by-replay, or incompatible-reject;
+  also derives the plan fingerprint stamped into checkpoint metadata.
+  ``diff_plans`` / ``plan_fingerprint``; driven by the ``evolve`` API.
 - **Repo lint** (``repo_lint``): AST checks over this codebase encoding
   invariants earlier PRs paid to learn (shared retry layer, no swallowed
   exceptions, determinism, no host-sync in hot paths, lock discipline,
@@ -50,6 +56,12 @@ from .diagnostics import (  # noqa: F401
     render_report,
     render_sarif,
     worst,
+)
+from .plan_diff import (  # noqa: F401
+    NodeClassification,
+    PlanDiff,
+    diff_plans,
+    plan_fingerprint,
 )
 from .plan_passes import PLAN_PASSES, PassContext, analyze_graph  # noqa: F401
 from .repo_lint import RULES as LINT_RULES  # noqa: F401

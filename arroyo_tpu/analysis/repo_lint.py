@@ -633,7 +633,7 @@ _DECLARED_FAULT_SITES = (
     "connector.poll", "connector.commit", "worker", "worker.heartbeat",
     "node.start_worker", "controller_rpc", "commit", "rescale",
     "autoscale_decide", "spill_write", "spill_probe", "spill_compact",
-    "admission", "fleet_place", "job_tick",
+    "admission", "fleet_place", "job_tick", "evolve_drain", "evolve_cutover",
 )
 
 

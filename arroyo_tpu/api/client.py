@@ -86,6 +86,15 @@ class ArroyoClient:
         """Planned dataflow DAG: {nodes: [...], edges: [...]}."""
         return self._req("GET", f"/api/v1/pipelines/{pipeline_id}/graph")
 
+    def evolve_pipeline(self, pipeline_id: str, query: str) -> dict:
+        """Live evolution (versioned redeploy): plan-diff the evolved SQL
+        against the running plan.  Compatible changes drain the job behind a
+        final checkpoint, carry proven state, and cut over blue/green; an
+        incompatible change raises ApiError(400) with AR-series diagnostics
+        and never touches the job."""
+        return self._req("POST", f"/api/v1/pipelines/{pipeline_id}/evolve",
+                         {"query": query})
+
     def list_jobs(self) -> list[dict]:
         return self._req("GET", "/api/v1/jobs")["data"]
 

@@ -220,6 +220,34 @@ def spec() -> dict:
                                    "type": "object", "properties": {
                                        "src": _STR, "dst": _STR,
                                        "type": _STR}}}}})},
+            "/api/v1/pipelines/{pipeline_id}/evolve": {
+                "post": _op(
+                    "evolve_pipeline",
+                    "live evolution (versioned redeploy): plan-diff the "
+                    "evolved SQL against the current plan; on success the "
+                    "running job drains behind a final checkpoint, carries "
+                    "proven state, and cuts over blue/green — an "
+                    "incompatible change is rejected here with AR-series "
+                    "diagnostics and never touches the job",
+                    ["pipeline_id"],
+                    body={"type": "object",
+                          "properties": {"query": _STR},
+                          "required": ["query"]},
+                    response={"type": "object", "properties": {
+                        "id": _STR, "job_id": _STR, "version": _INT,
+                        "classifications": {"type": "array", "items": {
+                            "type": "object", "properties": {
+                                "node_id": _STR,
+                                "action": {"type": "string",
+                                           "enum": ["carried", "rebuilt",
+                                                    "dropped", "stateless",
+                                                    "incompatible"]},
+                                "from": _STR, "detail": _STR}}},
+                        "diagnostics": {"type": "array", "items": {
+                            "type": "object", "properties": {
+                                "rule": _STR, "severity": _STR,
+                                "site": _STR, "message": _STR,
+                                "hint": _STR}}}}})},
             "/api/v1/pipelines/{pipeline_id}/jobs": {
                 "get": _op("pipeline_jobs", "jobs of a pipeline", ["pipeline_id"],
                            response={"type": "object",

@@ -13,6 +13,7 @@ Routes:
   GET    /api/v1/pipelines/{id}
   DELETE /api/v1/pipelines/{id}
   GET    /api/v1/pipelines/{id}/jobs
+  POST   /api/v1/pipelines/{id}/evolve {"query"}           -> classification
   GET    /api/v1/jobs
   GET    /api/v1/jobs/{id}
   PATCH  /api/v1/jobs/{id}            {"stop": "checkpoint"|"immediate"} |
@@ -99,6 +100,7 @@ class ApiServer:
         ("DELETE", r"^/api/v1/pipelines/([^/]+)$", "_delete_pipeline"),
         ("GET", r"^/api/v1/pipelines/([^/]+)/jobs$", "_pipeline_jobs"),
         ("GET", r"^/api/v1/pipelines/([^/]+)/graph$", "_pipeline_graph"),
+        ("POST", r"^/api/v1/pipelines/([^/]+)/evolve$", "_evolve_pipeline"),
         ("GET", r"^/api/v1/jobs$", "_list_jobs"),
         ("GET", r"^/api/v1/jobs/([^/]+)$", "_get_job"),
         ("PATCH", r"^/api/v1/jobs/([^/]+)$", "_patch_job"),
@@ -341,6 +343,62 @@ class ApiServer:
             h._json(400, {"error": str(e)})
             return
         h._json(200, {"nodes": nodes, "edges": edges})
+
+    def _evolve_pipeline(self, h, pid):
+        """Live evolution (versioned redeploy): validate the evolved SQL,
+        run the plan-diff pass against the CURRENT query, and — only when
+        no AR-series ERROR rejects the carry-over — hand the controller a
+        ``desired_query`` to actuate (drain behind a final checkpoint,
+        restore the evolved plan through the proven mapping, blue/green
+        cutover). An incompatible evolution is rejected HERE, at plan
+        time: it never reaches Scheduling and the running job is never
+        touched."""
+        from ..analysis.plan_diff import diff_plans
+        from ..sql import plan_query
+        from ..sql.lexer import SqlError
+
+        p = self.db.get_pipeline(pid)
+        if not p:
+            h._json(404, {"error": "not found"})
+            return
+        body = h._body()
+        query = body.get("query")
+        if not query:
+            h._json(400, {"error": "query is required"})
+            return
+        try:
+            self._activate_udfs()
+            scope = self.db.list_connection_tables()
+            old_graph = plan_query(p["query"],
+                                   connection_tables=scope).graph
+            new_graph = plan_query(query, connection_tables=scope).graph
+        except SqlError as e:
+            h._json(400, {"error": f"invalid query: {e}"})
+            return
+        diff = diff_plans(old_graph, new_graph)
+        payload = {
+            "classifications": [c.to_json() for c in diff.classifications],
+            "diagnostics": [d.to_dict() for d in diff.diagnostics],
+        }
+        if diff.rejected:
+            errs = "; ".join(f"{d.rule_id}: {d.message}"
+                             for d in diff.diagnostics
+                             if d.severity.name == "ERROR")
+            h._json(400, {"error": f"evolution rejected: {errs}", **payload})
+            return
+        live = [j for j in self.db.list_jobs(pid)
+                if j["state"] not in ("Failed", "Finished", "Stopped")]
+        if not live:
+            h._json(409, {"error": "pipeline has no live job to evolve; "
+                                   "restart it first"})
+            return
+        jid = live[-1]["id"]
+        if query == p["query"]:
+            h._json(200, {"id": pid, "job_id": jid, "noop": True, **payload})
+            return
+        self.db.update_job(jid, desired_query=query)
+        h._json(200, {"id": pid, "job_id": jid,
+                      "version": int(p.get("version") or 1) + 1, **payload})
 
     def _list_jobs(self, h):
         h._json(200, {"data": self.db.list_jobs()})

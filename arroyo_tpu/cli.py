@@ -63,6 +63,51 @@ def _cmd_check(args) -> int:
     return 0
 
 
+def _cmd_evolve(args) -> int:
+    """Live pipeline evolution (versioned redeploy): POST the evolved SQL to
+    /api/v1/pipelines/<id>/evolve, print the per-node plan-diff classification
+    (carried / rebuilt / stateless / dropped), and exit 0 once the controller
+    has accepted the drain + blue/green cutover. An incompatible change is
+    rejected server-side with AR-series diagnostics and exits 1 — the running
+    job is never touched."""
+    from arroyo_tpu.api.client import ApiError, ArroyoClient
+
+    with open(args.sql_file) as f:
+        query = f.read()
+    client = ArroyoClient(args.api)
+
+    def render(payload: dict) -> None:
+        cls = payload.get("classifications") or []
+        if cls:
+            width = max(len(c.get("node_id", "")) for c in cls)
+            for c in cls:
+                line = f"  {c.get('node_id', ''):<{width}}  {c.get('action', '')}"
+                if c.get("from"):
+                    line += f"  (from {c['from']})"
+                if c.get("detail"):
+                    line += f"  -- {c['detail']}"
+                print(line)
+        for d in payload.get("diagnostics") or []:
+            print(f"  {d.get('severity')} {d.get('rule')}: {d.get('message')}")
+            if d.get("hint"):
+                print(f"    hint: {d['hint']}")
+
+    try:
+        resp = client.evolve_pipeline(args.pipeline_id, query)
+    except ApiError as e:
+        payload = e.payload if isinstance(e.payload, dict) else {}
+        print(payload.get("error") or f"evolve failed: {e}", file=sys.stderr)
+        render(payload)
+        return 1
+    if resp.get("noop"):
+        print(f"pipeline {args.pipeline_id}: query unchanged, nothing to do")
+        return 0
+    print(f"evolution accepted: pipeline {args.pipeline_id} -> "
+          f"version {resp.get('version')} (job {resp.get('job_id')})")
+    render(resp)
+    return 0
+
+
 def _cmd_lint(args) -> int:
     """Repo lint + replay-soundness audit: AST checks over this codebase's
     own invariants (arroyo_tpu.analysis.repo_lint + state_audit; --json: a
@@ -742,6 +787,15 @@ def main(argv: Optional[list[str]] = None) -> int:
     ep.add_argument("--db", default=None,
                     help="read the controller DB file directly instead")
     ep.set_defaults(fn=_cmd_explain)
+
+    ev = sub.add_parser("evolve", help="live pipeline evolution: plan-diff "
+                                       "the new SQL, carry proven state, "
+                                       "blue/green cutover at a barrier")
+    ev.add_argument("pipeline_id")
+    ev.add_argument("sql_file", help="file holding the evolved SQL")
+    ev.add_argument("--api", default="http://127.0.0.1:5115",
+                    help="cluster API base url")
+    ev.set_defaults(fn=_cmd_evolve)
 
     kp = sub.add_parser("check", help="static analysis of a SQL pipeline "
                                       "(plan + dataflow validation, no run)")

@@ -141,9 +141,11 @@ class Autoscaler:
             self._up_ticks = self._down_ticks = 0
             return None
         if not running or self.in_flight is not None:
-            # never scale while Recovering/Stopping/Rescaling — the
-            # counters reset so a breach mid-restore can't fire at the
-            # first post-restore tick on stale conviction
+            # never scale while Recovering/Stopping/Rescaling/Evolving
+            # (or while an evolution request is pending — the controller
+            # gates `running` on that too) — the counters reset so a
+            # breach mid-restore can't fire at the first post-restore
+            # tick on stale conviction
             self._up_ticks = self._down_ticks = 0
             return None
         if ckpt_failures > 0:

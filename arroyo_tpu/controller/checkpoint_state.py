@@ -99,10 +99,14 @@ class CheckpointCoordinator:
 
     def __init__(self, job_id: str, storage_url: str,
                  expected: Iterable[SubtaskKey],
-                 event_log: Optional[list] = None):
+                 event_log: Optional[list] = None,
+                 plan_hash: Optional[str] = None):
         self.job_id = job_id
         self.storage_url = storage_url
         self.expected = frozenset(expected)
+        # plan fingerprint stamped into every epoch's job-level metadata so
+        # a later restore can prove it reads state its plan actually wrote
+        self.plan_hash = plan_hash
         self._lock = threading.Lock()
         self.pending: dict[int, CheckpointState] = {}
         self.finished: set[SubtaskKey] = set()
@@ -159,8 +163,11 @@ class CheckpointCoordinator:
         with self._lock:
             operators = sorted({k[0] for k in st.acked}
                                | {k[0] for k in (self.finished & self.expected)})
+        extra = {"operators": operators}
+        if self.plan_hash:
+            extra["plan_hash"] = self.plan_hash
         write_job_checkpoint_metadata(
-            self.storage_url, self.job_id, st.epoch, {"operators": operators})
+            self.storage_url, self.job_id, st.epoch, extra)
         trace_recorder.record(self.job_id, st.epoch, "metadata_durable")
         with self._lock:
             self.pending.pop(st.epoch, None)

@@ -75,6 +75,11 @@ class JobController:
         self.running_since: Optional[float] = None
         self.stopping_epoch: Optional[int] = None
         self.rescale_to: Optional[int] = None
+        # live evolution (versioned redeploy): the evolved SQL while the v1
+        # set drains, and the blue/green gate armed between the evolved
+        # restart and its first durable epoch (the cutover barrier)
+        self.evolve_to: Optional[str] = None
+        self._evolve_catchup = False
         self.failure: Optional[str] = None
         # stuck-checkpoint watchdog: epoch -> trigger time, plus the
         # consecutive-failure escalation counter and GC cadence counter
@@ -291,6 +296,17 @@ class JobController:
                 # adopted mid-rescale by a fresh controller: treat like a
                 # restart at the (already persisted) new parallelism
                 self._finish_rescale(job)
+        elif self.state == JobState.EVOLVING:
+            # the v1 set is draining behind its final checkpoint; keep
+            # supervising it — the finished/failed handlers do the actual
+            # Evolving -> Scheduling hop after the plan-diff pass proves
+            # (and persists) the state carry-over mapping
+            if self.handle is not None:
+                self._supervise(desired_stop, job)
+            else:
+                # adopted mid-evolve by a fresh controller: the drain is
+                # over; finish the evolution from the persisted request
+                self._finish_evolve(job)
         elif self.state in (JobState.RECOVERING, JobState.RESTARTING):
             restarts_allowed = config().get("pipeline.allowed-restarts")
             if self.state == JobState.RECOVERING and self.restarts > restarts_allowed:
@@ -344,6 +360,92 @@ class JobController:
                     epoch=self.restore_epoch,
                     data={"parallelism": self.parallelism})
         self._set_state(JobState.SCHEDULING, restore_epoch=self.restore_epoch,
+                        restarts=self.restarts)
+
+    def _finish_evolve(self, job: dict) -> None:
+        """The v1 set drained behind its final checkpoint. Re-prove the
+        state carry-over with the plan-diff pass against THAT drain (the
+        API's plan-time check may be stale by now), persist the evolution
+        mapping next to the checkpoint it applies to, bump the pipeline
+        version, and reschedule the evolved plan restoring through the
+        mapping. A rejection here restarts the UNCHANGED plan from its own
+        drain checkpoint — never a torn half-evolved lineage."""
+        if not self._hydrate_from_pipeline(job):
+            return
+        fresh = self.db.get_job(self.job_id) or job
+        new_sql = fresh.get("desired_query") or self.evolve_to
+        self.evolve_to = None
+        self.restore_epoch = latest_complete_checkpoint(
+            self.storage_url, self.job_id)
+        if not new_sql or new_sql == self.sql:
+            # request withdrawn (or no-op) between pickup and drain end:
+            # the drained set just restarts unchanged
+            self._set_state(JobState.SCHEDULING,
+                            restore_epoch=self.restore_epoch,
+                            restarts=self.restarts)
+            return
+        diff = None
+        reject_reason = ""
+        try:
+            from ..analysis.plan_diff import diff_plans
+            from ..sql import plan_query
+
+            scope = self.db.list_connection_tables()
+            old_graph = plan_query(self.sql, connection_tables=scope).graph
+            new_graph = plan_query(new_sql, connection_tables=scope).graph
+            diff = diff_plans(old_graph, new_graph)
+        except Exception as exc:  # noqa: BLE001 - reject, don't kill the job
+            reject_reason = f"evolved query failed to plan: {exc}"
+        if diff is not None and diff.rejected:
+            reject_reason = "; ".join(
+                f"{d.rule_id}: {d.message}" for d in diff.diagnostics
+                if d.severity.name == "ERROR")
+        if reject_reason:
+            self._event(
+                "ERROR", "JOB_EVOLVE_CLASSIFIED",
+                f"evolution rejected at the drain barrier: "
+                f"{reject_reason[:600]}",
+                data={"rejected": True,
+                      "classifications":
+                          [c.to_json() for c in diff.classifications]
+                          if diff is not None else []})
+            self.db.clear_desired_query(self.job_id, new_sql)
+            # the drained v1 restarts UNCHANGED from its own checkpoint
+            self._set_state(JobState.SCHEDULING,
+                            restore_epoch=self.restore_epoch,
+                            restarts=self.restarts)
+            return
+        counts: dict[str, int] = {}
+        for c in diff.classifications:
+            counts[c.action] = counts.get(c.action, 0) + 1
+        if self.restore_epoch:
+            # the mapping is epoch-keyed and atomically written: a crash
+            # anywhere after this point re-reads the SAME proof and the
+            # restore stays deterministic
+            from ..state.tables import write_evolution_mapping
+
+            write_evolution_mapping(self.storage_url, self.job_id,
+                                    self.restore_epoch, diff.mapping)
+        version = self.db.evolve_pipeline_query(job["pipeline_id"], new_sql)
+        self.db.clear_desired_query(self.job_id, new_sql)
+        self.sql = new_sql
+        # blue/green: phase-2 commits of the evolved set are withheld
+        # until its first durable epoch (the cutover barrier, see
+        # _epoch_durable); until then only staged output exists
+        self._evolve_catchup = True
+        self._event(
+            "INFO", "JOB_EVOLVE_CLASSIFIED",
+            "plan diff proved the carry-over: "
+            + ", ".join(f"{counts.get(k, 0)} {k}" for k in
+                        ("carried", "rebuilt", "dropped", "stateless"))
+            + f"; pipeline version {version}, restoring from epoch "
+              f"{self.restore_epoch or 0}",
+            epoch=self.restore_epoch,
+            data={"rejected": False, "version": version,
+                  "classifications":
+                      [c.to_json() for c in diff.classifications]})
+        self._set_state(JobState.SCHEDULING,
+                        restore_epoch=self.restore_epoch,
                         restarts=self.restarts)
 
     # ------------------------------------------------------------------
@@ -530,9 +632,21 @@ class JobController:
 
             _assignment, expected, _n = compute_assignment(
                 graph_json, len(self.handles))
+            # the coordinator writes the job-level metadata markers for
+            # this set, so IT stamps the plan fingerprint (single workers
+            # stamp their own in the engine); computed over the logical
+            # pre-chaining graph so both sides always agree
+            plan_hash = None
+            try:
+                from ..analysis.plan_diff import plan_fingerprint
+                from ..graph import Graph
+
+                plan_hash = plan_fingerprint(Graph.loads(graph_json))
+            except Exception:  # noqa: BLE001 - stamping is best-effort
+                plan_hash = None
             self.coordinator = CheckpointCoordinator(
                 self.job_id, self.storage_url, expected,
-                event_log=self.checkpoint_event_log)
+                event_log=self.checkpoint_event_log, plan_hash=plan_hash)
         # a fresh worker set starts a fresh checkpoint ledger (and a fresh
         # metrics view: the old set's counters restart from zero)
         self._inflight_epochs = {}
@@ -583,6 +697,8 @@ class JobController:
         self._inflight_epochs[epoch] = time.monotonic()
         rescaling = then_stop and (self.rescale_to is not None
                                    or self.state == JobState.RESCALING)
+        evolving = then_stop and (self.evolve_to is not None
+                                  or self.state == JobState.EVOLVING)
         from ..faults import fault_point
 
         for widx, h in enumerate(self.handles):
@@ -597,6 +713,17 @@ class JobController:
                 verdict = fault_point("rescale", epoch=epoch, worker=widx)
                 if verdict is not None and verdict[0] == "drop":
                     continue
+            if evolving:
+                # chaos site `evolve_drain`: the final-checkpoint drain
+                # command of a live evolution is lost to one worker.
+                # Recovery mirrors `rescale`: the unreached worker never
+                # acks, the stuck-epoch watchdog re-triggers the drain at
+                # a fresh epoch, and the evolved plan restores exactly the
+                # lineage that drain proved — never a torn one
+                verdict = fault_point("evolve_drain", epoch=epoch,
+                                      worker=widx)
+                if verdict is not None and verdict[0] == "drop":
+                    continue
             h.trigger_checkpoint(epoch, then_stop=then_stop)
 
     def _epoch_durable(self, epoch: int) -> None:
@@ -608,6 +735,43 @@ class JobController:
         self._inflight_epochs.pop(epoch, None)
         self._ckpt_failures = 0
         obs_trace.recorder.record(self.job_id, epoch, "metadata_durable")
+        if self._evolve_catchup and epoch != (self.restore_epoch or 0):
+            # blue/green cutover: the evolved (v2) set's first durable
+            # epoch proves it processed past the v1 drain watermark (its
+            # sources resumed from the carried offsets), so the withheld
+            # phase-2 commits may now be released — atomically at this
+            # barrier, via the cumulative commit delivery. For coordinated
+            # sets the `evolve_cutover` chaos site fires HERE, before any
+            # commit leaves the controller; single-worker engines fire it
+            # themselves at the same protocol point (engine.py).
+            self._evolve_catchup = False
+            if self.coordinator is not None:
+                from ..faults import fault_point
+
+                try:
+                    fault_point("evolve_cutover", epoch=epoch,
+                                key=self.job_id)
+                except Exception as exc:  # noqa: BLE001 - injected crash
+                    # crash AT the barrier: the epoch is durable but no
+                    # commit was released. Re-arm the gate and take the
+                    # normal recovery path — the restored set re-delivers
+                    # the withheld commits cumulatively
+                    # (COMMIT_REDELIVERED): one committed lineage, never
+                    # two
+                    self._evolve_catchup = True
+                    self._on_worker_failed(
+                        f"crash injected at the evolve cutover barrier "
+                        f"(epoch {epoch}): {exc}",
+                        self.db.get_job(self.job_id) or {})
+                    return
+            self._event("INFO", "JOB_EVOLVE_CUTOVER",
+                        f"cutover: evolved set caught up and went durable "
+                        f"at epoch {epoch}; releasing withheld commits",
+                        epoch=epoch)
+            self._event("INFO", "JOB_EVOLVE_DONE",
+                        "evolution complete: the evolved plan owns the "
+                        "single committed lineage",
+                        epoch=epoch)
         if self.coordinator is not None:
             self.coordinator.send_commits(
                 epoch,
@@ -712,12 +876,26 @@ class JobController:
         if self.state == JobState.RESCALING:
             self._finish_rescale(job)
             return True
+        if self.state == JobState.EVOLVING:
+            self._finish_evolve(job)
+            return True
         if self.state in (JobState.STOPPING, JobState.CHECKPOINT_STOPPING):
             if self._requeue_after_stop:
                 self._finish_preemption()
             else:
                 self._set_state(JobState.STOPPED)
         else:
+            if self._evolve_catchup:
+                # the evolved set drained to exhaustion before a periodic
+                # epoch could fire: its final flush IS the cutover barrier —
+                # everything it produced is committed exactly once at finish
+                self._evolve_catchup = False
+                self._event("INFO", "JOB_EVOLVE_CUTOVER",
+                            "cutover: evolved set drained to completion; "
+                            "its final flush releases the withheld commits")
+                self._event("INFO", "JOB_EVOLVE_DONE",
+                            "evolution complete: the evolved plan owns the "
+                            "single committed lineage")
             self._set_state(JobState.FINISHING)
             self._set_state(JobState.FINISHED)
         return True
@@ -757,6 +935,12 @@ class JobController:
             # exponential backoff before its NEXT decision
             self.autoscaler.on_scale_disrupted(error or "worker failure")
             self._finish_rescale(job)
+        elif self.state == JobState.EVOLVING:
+            # drain died mid-evolve: the evolution still proceeds, but
+            # from the freshest COMPLETE checkpoint — the plan-diff
+            # mapping is written against whatever epoch the restore
+            # actually uses, so a torn drain can never split the lineage
+            self._finish_evolve(job)
         elif self.state in (JobState.STOPPING, JobState.CHECKPOINT_STOPPING):
             if self._requeue_after_stop:
                 # the preemption drain died mid-flight; the job still
@@ -843,7 +1027,8 @@ class JobController:
         self.next_epoch += 1
         then_stop = False
         if self.stopping_epoch in stuck and self.state in (
-                JobState.CHECKPOINT_STOPPING, JobState.RESCALING):
+                JobState.CHECKPOINT_STOPPING, JobState.RESCALING,
+                JobState.EVOLVING):
             self.stopping_epoch = retry
             then_stop = True
         self._trigger_checkpoint(retry, then_stop=then_stop)
@@ -947,7 +1132,8 @@ class JobController:
         # stuck-checkpoint watchdog (checkpoint.timeout-ms)
         timeout_ms = cfgv.get("checkpoint.timeout-ms") or 0
         if timeout_ms and self._inflight_epochs and self.state in (
-                JobState.RUNNING, JobState.CHECKPOINT_STOPPING, JobState.RESCALING):
+                JobState.RUNNING, JobState.CHECKPOINT_STOPPING,
+                JobState.RESCALING, JobState.EVOLVING):
             now = time.monotonic()
             stuck = [e for e, t0 in sorted(self._inflight_epochs.items())
                      if (now - t0) * 1000 >= timeout_ms]
@@ -991,7 +1177,10 @@ class JobController:
         # fights the operator — and a non-Running tick only resets the
         # hysteresis counters.
         can_scale = (self.state == JobState.RUNNING and not desired_stop
-                     and not job.get("desired_parallelism"))
+                     and not job.get("desired_parallelism")
+                     # a pending live evolution owns the next drain cycle:
+                     # the autoscaler must not wedge a rescale in front of it
+                     and not job.get("desired_query"))
         target = self.autoscaler.evaluate(
             self._last_merged_metrics if can_scale else None,
             running=can_scale, parallelism=self.parallelism,
@@ -1047,6 +1236,30 @@ class JobController:
             if want and int(want) == self.parallelism:
                 # no-op rescale: clear the request
                 self.db.update_job(self.job_id, desired_parallelism=None)
+
+        # live evolution requests from the API (versioned redeploy,
+        # `POST /pipelines/<id>/evolve`): drain the running (v1) set behind
+        # a final checkpoint; _finish_evolve then proves the carry-over
+        # with the plan-diff pass and reschedules the evolved plan from
+        # exactly that checkpoint
+        if self.state == JobState.RUNNING and not desired_stop:
+            want_sql = job.get("desired_query")
+            if want_sql and want_sql != self.sql:
+                self.evolve_to = want_sql
+                self._event("INFO", "JOB_EVOLVE_STARTED",
+                            "evolution accepted: draining the running set "
+                            "behind a final checkpoint before the "
+                            "versioned redeploy",
+                            data={"drain_epoch": self.next_epoch})
+                self.stopping_epoch = self.next_epoch
+                self.next_epoch += 1
+                self._trigger_checkpoint(self.stopping_epoch,
+                                         then_stop=True)
+                self._set_state(JobState.EVOLVING)
+                return
+            if want_sql and want_sql == self.sql:
+                # no-op evolution: clear the request
+                self.db.clear_desired_query(self.job_id, want_sql)
 
         # stop requests from the API; a stop also voids any pending rescale
         # so it cannot resurrect as a surprise drain cycle at a later restart
@@ -1114,7 +1327,7 @@ class ControllerServer:
     # neighbors' heartbeat/watchdog checks
     _BUDGETED_STATES = (JobState.RUNNING, JobState.CHECKPOINT_STOPPING,
                         JobState.STOPPING, JobState.FINISHING,
-                        JobState.RESCALING)
+                        JobState.RESCALING, JobState.EVOLVING)
 
     def tick(self) -> None:
         for row in self.db.list_jobs():

@@ -22,6 +22,7 @@ CREATE TABLE IF NOT EXISTS pipelines (
     name TEXT NOT NULL,
     query TEXT NOT NULL,
     parallelism INTEGER NOT NULL DEFAULT 1,
+    version INTEGER NOT NULL DEFAULT 1,  -- bumped by each live evolution
     created_at REAL NOT NULL
 );
 CREATE TABLE IF NOT EXISTS jobs (
@@ -30,6 +31,7 @@ CREATE TABLE IF NOT EXISTS jobs (
     state TEXT NOT NULL,
     desired_stop TEXT,            -- NULL | 'checkpoint' | 'immediate'
     desired_parallelism INTEGER,  -- non-NULL requests a live rescale
+    desired_query TEXT,           -- non-NULL requests a live evolution
     restarts INTEGER NOT NULL DEFAULT 0,
     n_workers INTEGER NOT NULL DEFAULT 1,  -- size of the running worker set
     checkpoint_epoch INTEGER NOT NULL DEFAULT 0,
@@ -146,6 +148,8 @@ class Database:
                 "ALTER TABLE jobs ADD COLUMN n_workers INTEGER NOT NULL DEFAULT 1",
                 "ALTER TABLE jobs ADD COLUMN health TEXT",
                 "ALTER TABLE jobs ADD COLUMN tenant TEXT NOT NULL DEFAULT 'default'",
+                "ALTER TABLE jobs ADD COLUMN desired_query TEXT",
+                "ALTER TABLE pipelines ADD COLUMN version INTEGER NOT NULL DEFAULT 1",
                 "ALTER TABLE checkpoints ADD COLUMN phases TEXT",
             ):
                 try:
@@ -185,6 +189,30 @@ class Database:
         with self._lock:
             self._conn.execute(
                 "UPDATE pipelines SET parallelism=? WHERE id=?", (parallelism, pid))
+            self._conn.commit()
+
+    def evolve_pipeline_query(self, pid: str, query: str) -> int:
+        """Persist a completed live evolution: the pipeline's query becomes
+        the evolved SQL and its version lineage advances. Returns the new
+        version. Restarts re-plan from this row, so a job restarted after
+        the evolution committed runs the evolved plan."""
+        with self._lock:
+            self._conn.execute(
+                "UPDATE pipelines SET query=?, version=version+1 WHERE id=?",
+                (query, pid))
+            self._conn.commit()
+            row = self._conn.execute(
+                "SELECT version FROM pipelines WHERE id=?", (pid,)).fetchone()
+        return int(row["version"]) if row else 0
+
+    def clear_desired_query(self, jid: str, expected: str) -> None:
+        """Clear the evolve request iff it still holds the SQL we just
+        applied; a newer concurrent request survives to trigger again."""
+        with self._lock:
+            self._conn.execute(
+                "UPDATE jobs SET desired_query=NULL, updated_at=? "
+                "WHERE id=? AND desired_query=?",
+                (time.time(), jid, expected))
             self._conn.commit()
 
     def delete_pipeline(self, pid: str) -> None:

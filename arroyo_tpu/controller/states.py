@@ -2,8 +2,14 @@
 
 Mirrors the reference FSM (crates/arroyo-controller/src/states/mod.rs:47-228):
 Created -> Compiling -> Scheduling -> Running, with Recovering / Restarting /
-Rescaling / CheckpointStopping / Stopping and terminal Failed / Finished /
-Stopped. Transitions are validated so illegal jumps fail loudly.
+Rescaling / Evolving / CheckpointStopping / Stopping and terminal Failed /
+Finished / Stopped. Transitions are validated so illegal jumps fail loudly.
+
+Evolving (live pipeline evolution, this repo's addition) mirrors Rescaling:
+the running set drains behind a final checkpoint, the controller re-plans
+the NEW SQL, writes the evolution mapping the plan-diff pass proved sound
+(analysis/plan_diff.py), and the evolved plan re-enters Scheduling restoring
+carried state from the drained checkpoint.
 
 The multi-tenant fleet (controller/fleet.py) adds QUEUED between
 Compiling and Scheduling: a job the shared pool cannot place (or whose
@@ -28,6 +34,7 @@ class JobState(enum.Enum):
     RECOVERING = "Recovering"
     RESTARTING = "Restarting"
     RESCALING = "Rescaling"
+    EVOLVING = "Evolving"
     CHECKPOINT_STOPPING = "CheckpointStopping"
     STOPPING = "Stopping"
     FINISHING = "Finishing"
@@ -51,6 +58,7 @@ TRANSITIONS: dict[JobState, set[JobState]] = {
     # Running -> Queued: a deferred (lazy) placement was finally rejected
     # by every node — the job never actually ran and re-queues
     JobState.RUNNING: {JobState.RECOVERING, JobState.RESTARTING, JobState.RESCALING,
+                       JobState.EVOLVING,
                        JobState.CHECKPOINT_STOPPING, JobState.STOPPING,
                        JobState.FINISHING, JobState.FINISHED, JobState.FAILED,
                        JobState.QUEUED},
@@ -59,6 +67,9 @@ TRANSITIONS: dict[JobState, set[JobState]] = {
     JobState.RESTARTING: {JobState.SCHEDULING, JobState.QUEUED,
                           JobState.FAILED, JobState.STOPPED},
     JobState.RESCALING: {JobState.SCHEDULING, JobState.FAILED, JobState.STOPPED},
+    # Evolving: v1 drains behind a final checkpoint, then the evolved plan
+    # re-enters Scheduling with the carried-state mapping applied at restore
+    JobState.EVOLVING: {JobState.SCHEDULING, JobState.FAILED, JobState.STOPPED},
     # *Stopping -> Queued: a quota-change preemption drains the set behind
     # a final checkpoint, then the job re-enters the admission queue
     JobState.CHECKPOINT_STOPPING: {JobState.STOPPING, JobState.STOPPED,

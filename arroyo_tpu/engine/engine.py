@@ -111,6 +111,11 @@ class Engine:
         from ..faults import install_from_config
 
         install_from_config()
+        # plan fingerprint of the logical (pre-chaining) graph — the same
+        # graph the control plane planned, so controller and worker agree on
+        # the hash stamped into checkpoint metadata regardless of the
+        # chaining setting. Computed before chain_graph rewrites node ids.
+        self.plan_hash = self._fingerprint(graph)
         if config().get("pipeline.chaining.enabled"):
             from ..optimizer import chain_graph
 
@@ -167,6 +172,10 @@ class Engine:
         # set by _abort(): distinguishes a torn-down engine from a drained
         # one — an externally-killed worker must not report "finished"
         self._aborted = False
+        # armed by build() when restoring through an evolution mapping in
+        # single-worker mode: the first durable epoch is the blue/green
+        # cutover barrier (commits withheld until then)
+        self._evolve_cutover_pending = False
         # obs relay (worker subprocesses only; relay_obs set by the worker
         # CLI): epoch-lifecycle spans AND structured job events recorded in
         # this process are forwarded over the JSON-lines protocol so the
@@ -245,6 +254,19 @@ class Engine:
 
     # -------------------------------------------------------------- building
 
+    @staticmethod
+    def _fingerprint(graph: Graph) -> Optional[str]:
+        """analysis.plan_diff.plan_fingerprint, degraded to None when the
+        analysis package cannot run here (it instantiates operators; a
+        worker built before _load_operators simply skips stamping rather
+        than stamping a hash the controller would never match)."""
+        try:
+            from ..analysis.plan_diff import plan_fingerprint
+
+            return plan_fingerprint(graph)
+        except Exception:
+            return None
+
     def _is_mine(self, nid: str, sub: int) -> bool:
         if self.assignment is None:
             return True
@@ -257,13 +279,61 @@ class Engine:
 
     def build(self) -> None:
         g = self.graph
+        self.evolution_mapping: Optional[dict] = None
         if self.restore_epoch is not None:
-            from ..state.tables import read_job_checkpoint_metadata
+            from ..state.tables import (read_evolution_mapping,
+                                        read_job_checkpoint_metadata)
 
             meta = read_job_checkpoint_metadata(
                 self.storage_url, self.job_id, self.restore_epoch
             )
+            mapping = read_evolution_mapping(
+                self.storage_url, self.job_id, self.restore_epoch
+            )
+            # plan-fingerprint gate (degrade-not-corrupt): checkpointed
+            # bytes are typed by the plan that wrote them. A hash mismatch
+            # without a proven evolution mapping means this graph would
+            # misread them — fail loudly instead.
+            meta_hash = (meta or {}).get("plan_hash")
+            if (meta_hash and self.plan_hash
+                    and meta_hash != self.plan_hash):
+                if mapping is None:
+                    raise RuntimeError(
+                        f"checkpoint epoch {self.restore_epoch} was written "
+                        f"by plan {meta_hash} but this graph is plan "
+                        f"{self.plan_hash} and no evolution mapping covers "
+                        f"the change — restoring would misread state; run "
+                        f"the evolve API so the plan-diff pass can prove "
+                        f"(or reject) the carry-over"
+                    )
+                if (mapping.get("old_plan_hash") != meta_hash
+                        or mapping.get("new_plan_hash") != self.plan_hash):
+                    raise RuntimeError(
+                        f"evolution mapping for epoch {self.restore_epoch} "
+                        f"covers {mapping.get('old_plan_hash')} -> "
+                        f"{mapping.get('new_plan_hash')} but the restore is "
+                        f"{meta_hash} -> {self.plan_hash}; refusing a "
+                        f"mapping proven for a different plan pair"
+                    )
+            if mapping is not None:
+                self.evolution_mapping = mapping
+                # blue/green: a single-worker engine self-commits, so IT
+                # owns the cutover barrier — withhold phase-2 commits
+                # until the evolved plan's first epoch goes durable
+                # (coordinated sets gate in the controller instead)
+                self._evolve_cutover_pending = not self.coordinated
+            # operators the epoch holds state for that this graph lacks:
+            # under an evolution mapping those explicitly dropped or carried
+            # into a renamed successor are expected; anything else is a
+            # silent state drop and rejected
             stale = set((meta or {}).get("operators", ())) - set(g.nodes)
+            if mapping is not None:
+                expected_gone = set(mapping.get("dropped", ()))
+                expected_gone |= {
+                    str(m.get("from")) for m in mapping.get("nodes", {}).values()
+                    if m.get("from")
+                }
+                stale -= expected_gone
             if stale:
                 raise RuntimeError(
                     f"checkpoint epoch {self.restore_epoch} holds state for "
@@ -358,7 +428,10 @@ class Engine:
                     in_edge_of_input=edge_of_input,
                 )
                 if self.restore_epoch is not None:
-                    wm = tm.restore(self.restore_epoch, operator.tables())
+                    node_map = (self.evolution_mapping or {}).get(
+                        "nodes", {}).get(nid)
+                    wm = tm.restore(self.restore_epoch, operator.tables(),
+                                    mapping=node_map)
                     if wm is not None:
                         self.restored_watermark = (
                             wm if self.restored_watermark is None else min(self.restored_watermark, wm)
@@ -467,11 +540,38 @@ class Engine:
                 continue
             covered = set(ep) | self._clean_finished
             if len(covered) >= self._n_tasks:
+                extra = {"operators": list({k[0] for k in ep})}
+                if self.plan_hash:
+                    extra["plan_hash"] = self.plan_hash
                 write_job_checkpoint_metadata(
-                    self.storage_url, self.job_id, epoch,
-                    {"operators": list({k[0] for k in ep})},
+                    self.storage_url, self.job_id, epoch, extra,
                 )
                 self._span(epoch, "metadata_durable")
+                if self._evolve_cutover_pending:
+                    # blue/green cutover barrier (single-worker live
+                    # evolution): this is the evolved plan's first durable
+                    # epoch — it proves the new set caught up past the
+                    # carried offsets. The `evolve_cutover` chaos site
+                    # fires between durability and the commit release.
+                    self._evolve_cutover_pending = False
+                    from ..faults import fault_point
+
+                    try:
+                        fault_point("evolve_cutover", epoch=epoch,
+                                    key=self.job_id)
+                    except Exception as exc:  # noqa: BLE001 - injected
+                        # crash AT the barrier: the epoch's metadata is
+                        # durable but every commit stays withheld. The
+                        # restarted incarnation restores from THIS epoch
+                        # (same plan hash, no mapping needed) and the
+                        # sink re-commits its staged output idempotently
+                        # — exactly one committed lineage
+                        self._failed.append(ControlResp(
+                            kind="task_failed", node_id="<evolve_cutover>",
+                            error=f"injected crash at the evolve cutover "
+                                  f"barrier (epoch {epoch}): {exc}"))
+                        self._abort()
+                        return
                 self._completed_epochs.add(epoch)
                 # two-phase commit: metadata is durable, tell committing
                 # sinks to finalize (reference send_commit_messages,

@@ -83,6 +83,19 @@ Instrumented sites:
                         fleet.tick-budget-ms isolation must emit
                         JOB_TICK_OVERRUN and deprioritize it while its
                         neighbors keep their heartbeat/watchdog cadence
+    evolve_drain        the per-worker drain trigger of a live evolution
+                        (the final-checkpoint then_stop command; ctx:
+                        epoch, worker): drop/delay it mid-drain — the
+                        stuck-epoch watchdog must re-trigger the drain and
+                        the evolved plan must still restore exactly the
+                        drained lineage, never a torn one
+    evolve_cutover      the blue/green cutover barrier of a live evolution
+                        (ctx: epoch, key=job) — fires after the evolved
+                        set's first epoch is durable and BEFORE its
+                        withheld phase-2 commits are released; a crash
+                        here must recover to exactly one committed
+                        lineage (the commits re-deliver cumulatively on
+                        restart, COMMIT_REDELIVERED)
 """
 
 from __future__ import annotations
@@ -113,7 +126,7 @@ SITES = (
     "connector.poll", "connector.commit", "worker", "worker.heartbeat",
     "node.start_worker", "controller_rpc", "commit", "rescale",
     "autoscale_decide", "spill_write", "spill_probe", "spill_compact",
-    "admission", "fleet_place", "job_tick",
+    "admission", "fleet_place", "job_tick", "evolve_drain", "evolve_cutover",
 )
 
 

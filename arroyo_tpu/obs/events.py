@@ -110,6 +110,24 @@ EVENT_CODES: dict[str, tuple[str, str]] = {
                 "skipped for `penalty` ticks then always runs again) so a "
                 "melting job cannot starve its neighbors' heartbeat/"
                 "watchdog checks (data: ms, budget_ms, penalty)"),
+    "JOB_EVOLVE_STARTED": (
+        "INFO", "a live evolution (versioned redeploy) was accepted: the "
+                "running set drains behind a final checkpoint before the "
+                "evolved plan restores from it (data: drain_epoch)"),
+    "JOB_EVOLVE_CLASSIFIED": (
+        "INFO", "the plan-diff pass classified every operator of the "
+                "evolved plan (data: per-node carried/rebuilt/dropped/"
+                "stateless classifications, pipeline version); emitted at "
+                "ERROR with the AR-series diagnostics when the evolution "
+                "is rejected and the unchanged plan restarts instead"),
+    "JOB_EVOLVE_CUTOVER": (
+        "INFO", "blue/green cutover: the evolved set's first epoch went "
+                "durable (it caught up past the carried offsets) and its "
+                "withheld phase-2 commits are released atomically at this "
+                "barrier (epoch in scope)"),
+    "JOB_EVOLVE_DONE": (
+        "INFO", "the evolution finished: the evolved plan owns the single "
+                "committed lineage at its bumped pipeline version"),
     "SPILL_STARTED": (
         "INFO", "tiered state engaged: a subtask's resident state passed "
                 "its budget and cold partitions began spilling to storage "

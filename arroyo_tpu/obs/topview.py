@@ -64,6 +64,11 @@ def render(job: dict, metrics: Optional[dict],
         pos = job.get("queue_position")
         head += ("  queue_pos=" + (str(pos) if pos else "?"))
         return head + "\n  (queued for fleet admission; no worker set yet)"
+    if job.get("state") == "Evolving":
+        # live evolution: the v1 set drains behind a final checkpoint;
+        # the evolved plan restores from it once the carry-over is proven
+        head += "  evolving" + (" (redeploy pending)"
+                                if job.get("desired_query") else "")
     if not metrics:
         return head + "\n  (no metrics snapshot yet)"
     rows: list[tuple[str, ...]] = []

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import io
 import json
+import logging
 import os
 import pickle
 import threading
@@ -41,6 +42,8 @@ _PARQUET_IO_LOCK = threading.Lock()
 from ..batch import KEY_FIELD, TIMESTAMP_FIELD, Batch, Schema
 from ..types import TaskInfo
 from . import storage
+
+_log = logging.getLogger("arroyo_tpu.state")
 
 
 def _parquet_available() -> bool:
@@ -341,7 +344,8 @@ class TableManager:
         storage.write_text(os.path.join(opdir, f"metadata-{sub}.json"), json.dumps(meta))
         return meta
 
-    def restore(self, epoch: int, table_specs: list) -> Optional[int]:
+    def restore(self, epoch: int, table_specs: list,
+                mapping: Optional[dict] = None) -> Optional[int]:
         """Load state written at ``epoch`` (possibly at different parallelism).
 
         Subtasks absent from the epoch snapshot (they drained before the
@@ -350,9 +354,32 @@ class TableManager:
         constant after EOF, and everything it emitted was processed by
         downstream tasks before their epoch barriers, so its final state is
         consistent with any later epoch.
+
+        ``mapping`` is this node's entry of a live-evolution mapping
+        (analysis/plan_diff.py): ``{"action": "carried", "from": <old node
+        id>, "tables": [...]}`` redirects the read to the predecessor
+        plan's operator directory (the plan-diff pass proved the layouts
+        identical); ``{"action": "rebuilt"}`` restores nothing — the state
+        re-derives from replay. Under a mapping, checkpoint files for
+        tables the new operator does not declare are explicitly dropped
+        and logged, never silently resurrected.
+
         Returns the restored watermark (min across prior subtasks), if any.
         """
         ti = self.task_info
+        src_node = ti.node_id
+        if mapping:
+            action = mapping.get("action")
+            if action == "rebuilt":
+                _log.info(
+                    "evolve: %s state rebuilt by replay (no carry-over from "
+                    "epoch %s)", ti.node_id, epoch)
+                return None
+            if action == "carried" and mapping.get("from"):
+                src_node = str(mapping["from"])
+                if src_node != ti.node_id:
+                    _log.info("evolve: %s restores carried state from "
+                              "predecessor operator %s", ti.node_id, src_node)
 
         def read_metas(d: str) -> list:
             out = []
@@ -365,10 +392,10 @@ class TableManager:
                     out.append(m)
             return out
 
-        opdir = operator_dir(self.storage_url, ti.job_id, epoch, ti.node_id)
+        opdir = operator_dir(self.storage_url, ti.job_id, epoch, src_node)
         metas = read_metas(opdir)
         have_subtasks = {m["subtask_index"] for m in metas}
-        final_dir = operator_dir(self.storage_url, ti.job_id, "final", ti.node_id)
+        final_dir = operator_dir(self.storage_url, ti.job_id, "final", src_node)
         metas += [
             m for m in read_metas(final_dir) if m["subtask_index"] not in have_subtasks
         ]
@@ -411,6 +438,18 @@ class TableManager:
         }
         for tname, entries in by_table.items():
             spec = spec_by_name.get(tname)
+            if mapping and spec is None:
+                # evolution restore: a checkpointed table the evolved
+                # operator no longer declares. Dropping it is the proven-
+                # sound outcome (the plan-diff pass classified this node
+                # carried, so its declared set IS the old set — anything
+                # else is a leftover the new operator would never read);
+                # explicit and logged, never silently resurrected.
+                _log.warning(
+                    "evolve: dropping checkpointed table %r of %s (%d "
+                    "file(s)): not declared by the evolved operator",
+                    tname, ti.node_id, len(entries))
+                continue
             kind = entries[0][1].get("kind")
             if kind == "global_keyed":
                 self.global_keyed(tname).load_files(p for p, _ in entries)
@@ -629,6 +668,38 @@ def read_job_checkpoint_metadata(storage_url: str, job_id: str, epoch: int) -> O
     except (json.JSONDecodeError, OSError):
         # pre-atomic-write torn file: treat as metadata-less (restore
         # validation is skipped, matching pre-validation behavior)
+        return None
+
+
+def evolution_mapping_path(storage_url: str, job_id: str, epoch: int) -> str:
+    return os.path.join(storage_url, job_id, "checkpoints",
+                        f"evolution-{epoch:07d}.json")
+
+
+def write_evolution_mapping(
+    storage_url: str, job_id: str, epoch: int, mapping: dict
+) -> str:
+    """Persist the evolution mapping (analysis/plan_diff.py diff_plans) the
+    evolved plan restores ``epoch`` through. A storage sidecar — not a DB
+    row — so every worker incarnation (including crash-restart loops) reads
+    the SAME proven mapping; the atomic publish means a crash mid-evolve
+    leaves either no mapping (restore re-validates and re-writes) or the
+    complete one, never a torn half."""
+    path = evolution_mapping_path(storage_url, job_id, epoch)
+    storage.makedirs(os.path.dirname(path))
+    storage.write_text(path, json.dumps(mapping))
+    return path
+
+
+def read_evolution_mapping(
+    storage_url: str, job_id: str, epoch: int
+) -> Optional[dict]:
+    path = evolution_mapping_path(storage_url, job_id, epoch)
+    if not storage.exists(path):
+        return None
+    try:
+        return json.loads(storage.read_text(path))
+    except (json.JSONDecodeError, OSError):
         return None
 
 

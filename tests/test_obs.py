@@ -326,3 +326,18 @@ def test_top_and_trace_from_controller_db(tmp_path, _storage, capsys):
                     "testing.source-read-delay-micros": 0})
         ctl.stop()
         api.stop()
+
+
+def test_top_header_renders_evolving_state():
+    """`top` on an Evolving job says so (and flags the pending redeploy)
+    instead of rendering a bare metrics-less frame."""
+    from arroyo_tpu.obs.topview import render
+
+    job = {"id": "j1", "state": "Evolving", "health": "ok", "n_workers": 1,
+           "restarts": 0, "checkpoint_epoch": 3,
+           "desired_query": "SELECT 1"}
+    frame = render(job, None)
+    assert "evolving" in frame and "redeploy pending" in frame
+    # once the request is consumed the flag drops but the state still shows
+    frame = render({**job, "desired_query": None}, None)
+    assert "evolving" in frame and "redeploy pending" not in frame

@@ -1,0 +1,227 @@
+"""Plan-diff pass for live evolution (analysis/plan_diff.py).
+
+Covers the classification taxonomy (carried / rebuilt / dropped /
+incompatible / stateless), the AR010-012 diagnostics, the evolution mapping
+the restore path consumes, and the plan fingerprint stamped into checkpoint
+metadata (stable across replans and rescales, sensitive to anything that
+changes the meaning of checkpointed bytes).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from test_smoke import load_sql
+
+SMOKE = os.path.join(os.path.dirname(__file__), "smoke")
+
+
+def _graph(sql: str):
+    from arroyo_tpu.sql import plan_query
+
+    return plan_query(sql).graph
+
+
+def _load(name: str, out: str) -> str:
+    return load_sql(name, out)
+
+
+# evolved-query surgery shared with tests/test_evolve.py: each anchors on a
+# unique fragment of the smoke query so the fixture files stay the oracle
+def add_projected_column(sql: str, out: str, new_out: str = None) -> str:
+    """select_star with an extra projected column: the sink schema changes,
+    so the redefined sink also writes to a NEW path (``new_out``) — the v1
+    prefix stays where the v1 sink committed it, immutable."""
+    assert "SELECT * FROM cars" in sql
+    return sql.replace(
+        f"location TEXT\n) WITH (\n  connector = 'single_file',\n"
+        f"  path = '{out}'",
+        f"location TEXT,\n  location2 TEXT\n) WITH (\n"
+        f"  connector = 'single_file',\n  path = '{new_out or out}'",
+    ).replace(
+        "SELECT * FROM cars",
+        "SELECT timestamp, driver_id, event_type, location, "
+        "location AS location2 FROM cars",
+    )
+
+
+def add_noop_filter(sql: str) -> str:
+    """sliding_window with a semantically-empty filter (prices are >= 0)."""
+    assert "FROM bids\n" in sql
+    return sql.replace("FROM bids\n", "FROM bids WHERE price >= 0\n")
+
+
+def widen_window(sql: str) -> str:
+    """tumbling_aggregates with the window widened 10s -> 20s."""
+    assert "interval '10 seconds'" in sql
+    return sql.replace("interval '10 seconds'", "interval '20 seconds'")
+
+
+def _by_action(diff):
+    out: dict[str, list] = {}
+    for c in diff.classifications:
+        out.setdefault(c.action, []).append(c)
+    return out
+
+
+def test_fingerprint_stable_roundtrip_and_rescale_invariant(tmp_path):
+    from arroyo_tpu.graph import Graph
+    from arroyo_tpu.analysis.plan_diff import plan_fingerprint
+    from arroyo_tpu.sql.planner import set_parallelism
+
+    sql = _load("select_star", str(tmp_path / "o.json"))
+    g1, g2 = _graph(sql), _graph(sql)
+    fp = plan_fingerprint(g1)
+    assert fp and plan_fingerprint(g2) == fp, "replanning must not move the fp"
+    # parallelism is deliberately excluded: a rescale restores the same fp
+    set_parallelism(g2, 3)
+    assert plan_fingerprint(g2) == fp
+    # the control plane ships IR through dumps/loads; the fp must survive
+    assert plan_fingerprint(Graph.loads(g1.dumps())) == fp
+    # a different pipeline is a different fp
+    other = _graph(_load("tumbling_aggregates", str(tmp_path / "o2.json")))
+    assert plan_fingerprint(other) != fp
+
+
+def test_identical_plans_carry_everything(tmp_path):
+    from arroyo_tpu.analysis.plan_diff import diff_plans, node_identity
+
+    sql = _load("tumbling_aggregates", str(tmp_path / "o.json"))
+    old, new = _graph(sql), _graph(sql)
+    diff = diff_plans(old, new)
+    assert not diff.rejected and not diff.diagnostics
+    by = _by_action(diff)
+    stateful = [n.node_id for n in new.topo_order() if node_identity(n).stateful]
+    assert sorted(c.node_id for c in by.get("carried", [])) == sorted(stateful)
+    assert not by.get("incompatible") and not by.get("dropped")
+    assert diff.mapping["old_plan_hash"] == diff.mapping["new_plan_hash"]
+    for nid in stateful:
+        assert diff.mapping["nodes"][nid]["action"] == "carried"
+
+
+def test_add_projected_column_sink_rebuilt_rest_carried(tmp_path):
+    from arroyo_tpu.analysis.plan_diff import diff_plans
+
+    out = str(tmp_path / "o.json")
+    sql = _load("select_star", out)
+    diff = diff_plans(_graph(sql), _graph(add_projected_column(sql, out)))
+    assert not diff.rejected
+    by = _by_action(diff)
+    # the redefined sink restarts empty (its buffers flush at the drain
+    # barrier); the source's offsets carry so no row is lost or replayed
+    rebuilt = by.get("rebuilt", [])
+    assert len(rebuilt) == 1 and rebuilt[0].node_id.startswith("sink")
+    assert rebuilt[0].from_node and rebuilt[0].from_node.startswith("sink")
+    assert any(c.node_id.startswith("source") for c in by.get("carried", []))
+    assert {d.rule_id for d in diff.diagnostics} == {"AR011"}
+    assert all(d.severity.name == "INFO" for d in diff.diagnostics)
+    # the old sink's buffered state is explicitly dropped in the mapping so
+    # the engine's stale-operator check knows it was accounted for
+    assert rebuilt[0].from_node in diff.mapping["dropped"]
+
+
+def test_add_filter_windows_carried(tmp_path):
+    from arroyo_tpu.analysis.plan_diff import diff_plans
+
+    sql = _load("sliding_window", str(tmp_path / "o.json"))
+    diff = diff_plans(_graph(sql), _graph(add_noop_filter(sql)))
+    assert not diff.rejected, [d.to_dict() for d in diff.diagnostics]
+    by = _by_action(diff)
+    assert any("sliding_aggregate" in c.node_id
+               for c in by.get("carried", [])), by
+    assert not by.get("incompatible")
+
+
+def test_widen_window_rejected_ar010(tmp_path):
+    from arroyo_tpu.analysis.plan_diff import diff_plans
+
+    sql = _load("tumbling_aggregates", str(tmp_path / "o.json"))
+    diff = diff_plans(_graph(sql), _graph(widen_window(sql)))
+    assert diff.rejected
+    errs = [d for d in diff.diagnostics if d.severity.name == "ERROR"]
+    assert errs and all(d.rule_id == "AR010" for d in errs)
+    by = _by_action(diff)
+    assert by.get("incompatible"), "the widened window must be named"
+    assert all(c.from_node for c in by["incompatible"])
+
+
+def test_removed_aggregation_dropped_ar012(tmp_path):
+    from arroyo_tpu.analysis.plan_diff import diff_plans
+
+    out = str(tmp_path / "o.json")
+    old_sql = _load("tumbling_aggregates", out)
+    # the evolved plan removes the aggregation entirely: passthrough of the
+    # same source into a sink of the raw schema
+    new_sql = f"""
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '{os.path.join(SMOKE, "inputs")}/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE raw_output (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '{out}',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO raw_output SELECT * FROM impulse_source;
+"""
+    diff = diff_plans(_graph(old_sql), _graph(new_sql))
+    # dropping state is allowed — loudly (WARNING), never silently
+    assert not diff.rejected
+    warns = [d for d in diff.diagnostics if d.rule_id == "AR012"]
+    assert warns and all(d.severity.name == "WARNING" for d in warns)
+    by = _by_action(diff)
+    assert by.get("dropped")
+    for c in by["dropped"]:
+        assert c.node_id in diff.mapping["dropped"]
+
+
+def test_mapping_shape_matches_restore_contract(tmp_path):
+    """The sidecar the controller persists is exactly what Engine.build /
+    TableManager.restore consume: node actions keyed by NEW id, carried
+    entries naming their source node and tables, hashes for the gate."""
+    from arroyo_tpu.analysis.plan_diff import diff_plans, plan_fingerprint
+
+    out = str(tmp_path / "o.json")
+    sql = _load("sliding_window", out)
+    old, new = _graph(sql), _graph(add_noop_filter(sql))
+    diff = diff_plans(old, new)
+    m = diff.mapping
+    assert m["old_plan_hash"] == plan_fingerprint(old)
+    assert m["new_plan_hash"] == plan_fingerprint(new)
+    assert m["old_plan_hash"] != m["new_plan_hash"]
+    for nid, entry in m["nodes"].items():
+        assert nid in new.nodes
+        assert entry["action"] in ("carried", "rebuilt", "stateless")
+        if entry["action"] == "carried":
+            assert entry["from"] in old.nodes
+            assert isinstance(entry["tables"], list)
+
+
+def test_evolution_mapping_sidecar_roundtrip(tmp_path, _storage):
+    from arroyo_tpu.state.tables import (read_evolution_mapping,
+                                         write_evolution_mapping)
+
+    mapping = {"old_plan_hash": "a" * 16, "new_plan_hash": "b" * 16,
+               "nodes": {"window_1_w": {"action": "carried",
+                                        "from": "window_0_w",
+                                        "tables": ["w"]}},
+               "dropped": ["sink_2_old"]}
+    assert read_evolution_mapping(_storage, "job-x", 3) is None
+    write_evolution_mapping(_storage, "job-x", 3, mapping)
+    assert read_evolution_mapping(_storage, "job-x", 3) == mapping
+    # epoch-keyed: a different epoch sees nothing
+    assert read_evolution_mapping(_storage, "job-x", 4) is None

@@ -145,6 +145,7 @@ rule_ids = {rid for rid, _sev, _fn in LINT_RULES} | set(AUDIT_RULES) \
     | set(TRACE_RULES)
 ID_RE = re.compile(r"^(AR|LR)\d{3}$")
 for p in ("arroyo_tpu/analysis/plan_passes.py",
+          "arroyo_tpu/analysis/plan_diff.py",
           "arroyo_tpu/analysis/trace_audit.py",
           "arroyo_tpu/analysis/__init__.py"):
     with open(p) as f:
