@@ -35,6 +35,18 @@ Two engines share one diagnostic model (``diagnostics.Diagnostic``):
   time and rejects dtype-divergent pipelines before they run; its static
   jnp dtype model and the allowlist's bit-exactness are cross-checked at
   runtime by tests/test_trace_audit.py.
+- **Concurrency auditor** (``concurrency_audit``, LR4xx): a whole-program
+  pass over the threaded control plane (engine/state/controller) building
+  a per-class thread-role model (``threading.Thread(target=...)`` seeds,
+  ``# thread: <role>`` annotations, implicit caller role) and a
+  lock-attribution map (``with self.<lock>:`` regions resolved through
+  same-class helper closures and entry contexts). Emits LR401
+  unlocked-shared-attr, LR402 lock-order cycles (SCC over the global
+  acquires-while-holding graph), LR403 interprocedural
+  lock-across-blocking (subsumes LR105, whose id stays a waiver alias),
+  and LR404 non-atomic check-then-act. The static LR402 graph is
+  cross-checked at runtime by the lock-order witness (obs/lockorder.py)
+  in tests/test_concurrency_audit.py.
 
 ``lint --json`` / ``check --json`` emit the diagnostics as a JSON array
 (rule, severity, site, message, fix hint) with unchanged exit codes.
@@ -78,6 +90,13 @@ from .trace_audit import (  # noqa: F401
     audit_trace_modules,
     audit_trace_source,
     audit_trace_sources,
+)
+from .concurrency_audit import RULES as CONCURRENCY_RULES  # noqa: F401
+from .concurrency_audit import (  # noqa: F401
+    audit_concurrency_modules,
+    audit_concurrency_source,
+    static_lock_graph,
+    static_lock_graph_package,
 )
 
 

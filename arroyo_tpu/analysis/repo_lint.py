@@ -20,9 +20,12 @@ Rule catalog:
     LR104 host-sync-hot-path   ``.block_until_ready()`` / ``float()`` /
                                ``np.asarray`` on device values inside
                                operator ``process_batch`` hot paths
-    LR105 lock-across-blocking ``with <lock>:`` regions containing blocking
-                               calls (sleep/socket/storage/queue) in the
-                               threaded engine
+    LR105 lock-across-blocking RETIRED as a standalone rule: folded into
+                               the interprocedural LR403 (concurrency
+                               auditor), which follows same-class helper
+                               calls to the blocking sink. The LR105 id
+                               still binds as a waiver alias at LR403
+                               sites, so existing waivers keep suppressing
     LR106 fault-site-coverage  storage/network/queue mutations must route
                                through ``faults`` hooks; every declared
                                fault site must be wired somewhere
@@ -367,45 +370,10 @@ def rule_lr104(mod: ModuleInfo) -> Iterable[Finding]:
                        "checkpoint time")
 
 
-_LR105_BLOCKING = {"sleep", "sendall", "recv", "accept", "connect",
-                   "urlopen", "check_output", "put_bytes", "get_bytes",
-                   "read_bytes", "write_bytes"}
-
-
-def rule_lr105(mod: ModuleInfo) -> Iterable[Finding]:
-    """Blocking calls inside a with-lock region of the threaded engine:
-    every other thread contending that lock stalls for the full call."""
-    if not mod.in_dirs("engine", "state", "controller"):
-        return
-    # with-lock region map: every `with <...lock...>:` statement body
-    for node in ast.walk(mod.tree):
-        if not isinstance(node, ast.With):
-            continue
-        if not any(_mentions_lock(item.context_expr) for item in node.items):
-            continue
-        for n in _walk_skipping_nested_defs(node):
-            if not isinstance(n, ast.Call):
-                continue
-            name = _call_name(n)
-            recv = _receiver_name(n)
-            blocking = name in _LR105_BLOCKING
-            if name == "join" and recv not in ("path", "os"):
-                # thread/process join; os.path.join and "".join are not
-                blocking = not isinstance(
-                    getattr(n.func, "value", None), ast.Constant)
-            if name in ("get", "put") and (
-                    "queue" in recv.lower() or "inbox" in recv.lower()):
-                blocking = not any(
-                    isinstance(k.value, ast.Constant) and k.value.value is False
-                    for k in n.keywords if k.arg == "block"
-                )
-            if blocking:
-                yield (n.lineno,
-                       f"blocking call {name}() while holding a lock "
-                       f"(with-lock region at line {node.lineno}): all "
-                       "contending threads stall for the full call",
-                       "move the blocking call outside the lock (copy state "
-                       "under the lock, act on it after release)")
+# LR105 (intraprocedural lock-across-blocking) is retired: the concurrency
+# auditor's LR403 subsumes it with interprocedural reach (same-class helper
+# closures, lock entry contexts) and runs in every lint_paths sweep below.
+# Existing `# lint: waive LR105` comments still bind at LR403 sites.
 
 
 # file-suffix -> (functions that mutate storage/network/queues, gateways
@@ -616,7 +584,6 @@ RULES: tuple[tuple[str, Severity, object], ...] = (
     ("LR102", Severity.ERROR, rule_lr102),
     ("LR103", Severity.ERROR, rule_lr103),
     ("LR104", Severity.WARNING, rule_lr104),
-    ("LR105", Severity.ERROR, rule_lr105),
     ("LR106", Severity.ERROR, rule_lr106),
     ("LR107", Severity.ERROR, rule_lr107),
     ("LR108", Severity.ERROR, rule_lr108),
@@ -634,6 +601,7 @@ _DECLARED_FAULT_SITES = (
     "node.start_worker", "controller_rpc", "commit", "rescale",
     "autoscale_decide", "spill_write", "spill_probe", "spill_compact",
     "admission", "fleet_place", "job_tick", "evolve_drain", "evolve_cutover",
+    "lock_contend",
 )
 
 
@@ -723,6 +691,12 @@ def lint_paths(paths: list[str], root: Optional[str] = None) -> list[Diagnostic]
         from .trace_audit import audit_trace_modules
 
         diags.extend(audit_trace_modules(parsed))
+        # concurrency audit (LR4xx): whole-program over the sweep — classes
+        # resolve across every parsed module, findings self-scope to the
+        # threaded engine/state/controller layers
+        from .concurrency_audit import audit_concurrency_modules
+
+        diags.extend(audit_concurrency_modules(parsed))
     if saw_faults_pkg:
         for site in _DECLARED_FAULT_SITES:
             if site not in wired_sites:

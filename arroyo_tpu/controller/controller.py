@@ -1296,6 +1296,7 @@ class ControllerServer:
             config().get("controller.scheduler"), db)
         self.storage_url = storage_url
         self.poll_interval = poll_interval
+        # concurrency: single-writer — mutated only inside tick(), which runs either on the controller thread (start()) or inline in tests, never both; stop() reads after joining the thread
         self.jobs: dict[str, JobController] = {}
         # the multi-tenant fleet: one shared slot pool / admission queue
         # across every job this controller supervises
@@ -1305,9 +1306,9 @@ class ControllerServer:
         # up to tick-penalty-max ticks) so a melting job cannot starve
         # its neighbors' heartbeat/watchdog checks — but it always runs
         # again, never skipped forever
-        self._tick_penalty: dict[str, int] = {}
-        self._tick_skip: dict[str, int] = {}
-        self._overrun_emitted: dict[str, float] = {}
+        self._tick_penalty: dict[str, int] = {}  # concurrency: single-writer — tick()-private (see jobs above)
+        self._tick_skip: dict[str, int] = {}  # concurrency: single-writer — tick()-private (see jobs above)
+        self._overrun_emitted: dict[str, float] = {}  # concurrency: single-writer — tick()-private (see jobs above)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

@@ -63,6 +63,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..obs.lockorder import make_lock
+
 _log = logging.getLogger("arroyo_tpu.controller.fleet")
 
 
@@ -119,7 +121,7 @@ class FleetManager:
                  clock: Callable[[], float] = time.monotonic):
         self.scheduler = scheduler
         self._clock = clock
-        self._lock = threading.RLock()
+        self._lock = make_lock("FleetManager._lock", kind="rlock")
         self._held: dict[str, _Held] = {}
         self._queues: dict[str, deque[_Queued]] = {}
         self._backoff: dict[str, _Backoff] = {}
@@ -159,11 +161,12 @@ class FleetManager:
     def pool_slots(self) -> Optional[int]:
         """Current pool size in slots; None = unlimited (feature off)."""
         base = int(_cfg("slots", 0) or 0)
-        if base > 0:
-            if self._dyn_pool is not None:
-                return max(base, self._dyn_pool)
-            return base
-        return self._node_capacity  # None unless a node probe populated it
+        with self._lock:  # _dyn_pool / _node_capacity land on other threads
+            if base > 0:
+                if self._dyn_pool is not None:
+                    return max(base, self._dyn_pool)
+                return base
+            return self._node_capacity  # None until a node probe lands
 
     def _achievable_pool(self) -> float:
         """The largest pool this fleet could ever offer a single job:
@@ -219,7 +222,8 @@ class FleetManager:
                     # slots — placement itself discovers the truth
                     # (409 -> requeue)
                     total += int(n.get("slots") or 0)
-            self._node_capacity = total if nodes else None
+            with self._lock:  # published to pool_slots() readers
+                self._node_capacity = total if nodes else None
 
         self._probe_thread = threading.Thread(
             target=_probe, daemon=True, name="fleet-node-probe")

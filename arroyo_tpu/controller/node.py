@@ -21,6 +21,8 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..obs.lockorder import make_lock
+
 
 def _post(url: str, body: dict, timeout: float = 10.0) -> dict:
     from ..config import config
@@ -60,7 +62,7 @@ class NodeServer:
         # registration that dials home
         self.node_id = config().get("node.id") or f"node_{uuid.uuid4().hex[:12]}"
         self._workers: dict[str, object] = {}  # worker_id -> ProcessWorkerHandle
-        self._lock = threading.Lock()
+        self._lock = make_lock("NodeServer._lock")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -105,6 +107,10 @@ class NodeServer:
         ("GET", r"^/status$", "_status"),
     ]
 
+    # ThreadingHTTPServer runs each request on its own thread; everything
+    # _route reaches shares that role (the static auditor cannot see
+    # through BaseHTTPRequestHandler dispatch)
+    # thread: http-request
     def _route(self, h, method: str) -> None:
         path = h.path.split("?", 1)[0]
         for m, pat, name in self._ROUTES:

@@ -104,7 +104,12 @@ class EmbeddedWorkerHandle(WorkerHandle):
                                  storage_url=storage_url)
         self._events: "queue.Queue[dict]" = queue.Queue()
         self._reported_epochs: set[int] = set()
-        self._done = False
+        # _emit_epochs runs on BOTH the worker thread (_run) and the
+        # controller thread (poll_events): without the lock two concurrent
+        # emits can both compute the completed-minus-reported difference
+        # before either records it, double-reporting an epoch
+        self._emit_lock = threading.Lock()
+        self._done = False  # concurrency: single-writer — monotonic done flag; set once by the worker thread, stale reads just delay done-detection one poll
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -138,21 +143,28 @@ class EmbeddedWorkerHandle(WorkerHandle):
                 except queue.Empty:
                     break
         else:
-            for ep in sorted(self.engine._completed_epochs - self._reported_epochs):
-                self._reported_epochs.add(ep)
-                self._events.put({"event": "checkpoint_completed", "epoch": ep})
+            with self._emit_lock:
+                for ep in sorted(
+                        self.engine._completed_epochs - self._reported_epochs):
+                    self._reported_epochs.add(ep)
+                    self._events.put(
+                        {"event": "checkpoint_completed", "epoch": ep})
         from ..connectors.preview import take_preview_rows
 
         lines = take_preview_rows(self.engine.job_id)
         if lines:
             self._events.put({"event": "sink_data", "lines": lines})
         now = time.monotonic()
-        if now - getattr(self, "_last_metrics", 0.0) >= 1.0:
-            self._last_metrics = now
+        with self._emit_lock:
+            due = now - getattr(self, "_last_metrics", 0.0) >= 1.0
+            if due:
+                self._last_metrics = now
+        if due:
             from ..metrics import registry as _mreg
 
             self._events.put({
-                "event": "metrics", "data": _mreg.job_metrics(self.engine.job_id)
+                "event": "metrics",
+                "data": _mreg.job_metrics(self.engine.job_id),
             })
 
     def trigger_checkpoint(self, epoch: int, then_stop: bool = False) -> None:

@@ -146,8 +146,9 @@ class Engine:
         # stable numeric node ids for Quad addressing
         self._node_index = {nid: i for i, nid in enumerate(sorted(graph.nodes))}
         self.resp_queue: "_queue.Queue[ControlResp]" = _queue.Queue()
+        # concurrency: single-writer — tasks/_inboxes are populated by build() before start() spawns the collector; Thread.start() is the happens-before edge, after which nobody mutates the dicts
         self.tasks: dict[tuple[str, int], Task] = {}
-        self._inboxes: dict[tuple[str, int], TaskInbox] = {}
+        self._inboxes: dict[tuple[str, int], TaskInbox] = {}  # concurrency: single-writer — same build()-then-start() discipline as tasks
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._finished_tasks: set[tuple[str, int]] = set()
@@ -157,10 +158,12 @@ class Engine:
         # "complete" with a subtask's snapshot missing and a restore would
         # replay its source from zero
         self._clean_finished: set[tuple[str, int]] = set()
+        # concurrency: single-writer — appended only by the collector thread; join()'s unlocked reads are GIL-atomic list snapshots (truthiness + element 0)
         self._failed: list[ControlResp] = []
         self._checkpoints: dict[int, dict[tuple[str, int], dict]] = {}
         self._completed_epochs: set[int] = set()
         self._resp_thread: Optional[threading.Thread] = None
+        # concurrency: single-writer — set by build() before the collector thread exists (see tasks above)
         self._n_tasks = 0
         self.restored_watermark: Optional[int] = None
         # triggers that arrived before build() populated the source tasks —
@@ -175,6 +178,7 @@ class Engine:
         # armed by build() when restoring through an evolution mapping in
         # single-worker mode: the first durable epoch is the blue/green
         # cutover barrier (commits withheld until then)
+        # concurrency: single-writer — armed by build() pre-thread; cleared only by the collector under _lock
         self._evolve_cutover_pending = False
         # obs relay (worker subprocesses only; relay_obs set by the worker
         # CLI): epoch-lifecycle spans AND structured job events recorded in
@@ -581,6 +585,7 @@ class Engine:
                         continue
                     opv = getattr(task, "operator", None)
                     if opv is not None and getattr(opv, "is_committing", lambda: False)():
+                        # lint: waive LR403 — control_queue is an unbounded queue.Queue; put() never blocks, so holding _lock across it cannot stall
                         task.control_queue.put(
                             ControlMessage(kind="commit", epoch=epoch)
                         )
@@ -731,6 +736,7 @@ class Engine:
 
     def stop(self) -> None:
         for t in self.source_tasks():
+            # lint: waive LR403 — control_queue is an unbounded queue.Queue; put() never blocks (flagged via the _abort -> stop() reach under _lock)
             t.control_queue.put(ControlMessage(kind="stop"))
 
     def _abort(self) -> None:

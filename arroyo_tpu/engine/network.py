@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Optional
 
+from ..obs.lockorder import make_lock
+
 from ..batch import Batch
 from ..config import config
 from ..faults import InjectedFault, fault_point
@@ -50,7 +52,7 @@ class _SendBuffer:
         self.max_bytes = max_bytes
         self._chunks: list[bytes] = []
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("_SendBuffer._lock")
         self._error: Optional[Exception] = None
 
     def append(self, quad, mtype: int, payload: bytes, flush: bool) -> None:
@@ -73,18 +75,29 @@ class _SendBuffer:
                 try:
                     self._flush_locked()
                 except Exception as e:
-                    self._error = e
+                    if self._error is None:  # _flush_locked latched already
+                        self._error = e
 
     def _flush_locked(self) -> None:
         blob = b"".join(self._chunks)
         self._chunks, self._bytes = [], 0
+        # the conn send lock is taken INSIDE the buffer lock on purpose:
+        # a frame must hit the fd atomically and in append order, so the
+        # buffer drains while both are held (direct conn.send callers take
+        # only the inner lock — same order, no cycle)
         with self.conn._send_lock:
             view = memoryview(blob)
             while view:
                 try:
+                    # lint: waive LR403 — deliberate: frame atomicity and append order require writing under both locks; contenders here are exactly the senders whose frames must serialize
                     n = os.write(self.conn.fd, view)
                 except OSError as e:
-                    raise ConnectionError(f"data plane write failed: {e}") from e
+                    # latch HERE, not in flush_pending: the append path also
+                    # reaches this point, and a torn stream must poison later
+                    # appends no matter which caller hit the error first
+                    self._error = ConnectionError(
+                        f"data plane write failed: {e}")
+                    raise self._error from e
                 view = view[n:]
 
 
@@ -137,12 +150,14 @@ class NetworkManager:
         self.port = self.listener.port
         self.peers: dict[int, tuple[str, int]] = {}
         self._out: dict[int, DataPlaneConn] = {}
-        self._out_lock = threading.Lock()
+        self._out_lock = make_lock("NetworkManager._out_lock")
         # quad -> (inbox, flat_input_index)
+        # concurrency: single-writer — receivers register during task wiring, before start() spawns readers; a late frame for an unknown quad is dropped by design
         self._receivers: dict[tuple[int, int, int, int], tuple] = {}
         self._accept_thread: Optional[threading.Thread] = None
         self._flush_thread: Optional[threading.Thread] = None
         self._reader_threads: list[threading.Thread] = []
+        # concurrency: single-writer — monotonic stop flag set once by close(); a stale read costs one extra loop tick, never correctness
         self._closed = False
         c = config()
         self._coalesce = bool(c.get("engine.coalesce.enabled", True))
@@ -174,10 +189,11 @@ class NetworkManager:
         coalescing is disabled)."""
         if not self._coalesce:
             return None
-        buf = self._send_bufs.get(worker)
+        with self._out_lock:
+            buf = self._send_bufs.get(worker)
         if buf is not None:
             return buf
-        conn = self.conn_to(worker)
+        conn = self.conn_to(worker)  # dial outside the lock
         with self._out_lock:
             buf = self._send_bufs.get(worker)
             if buf is None:
@@ -192,7 +208,9 @@ class NetworkManager:
         sleep would let a just-missed frame wait ~2x the knob."""
         while not self._closed:
             time.sleep(self._co_max_delay_s)
-            for buf in list(self._send_bufs.values()):
+            with self._out_lock:  # snapshot; flush outside the dict lock
+                bufs = list(self._send_bufs.values())
+            for buf in bufs:
                 buf.flush_pending()
 
     def conn_to(self, worker: int) -> DataPlaneConn:
@@ -253,7 +271,9 @@ class NetworkManager:
     def close(self) -> None:
         self._closed = True
         self.listener.close()
-        for buf in list(self._send_bufs.values()):
+        with self._out_lock:  # snapshot; drain outside the dict lock
+            bufs = list(self._send_bufs.values())
+        for buf in bufs:
             # best-effort drain so frames sent just before close still land
             buf.flush_pending()
         with self._out_lock:

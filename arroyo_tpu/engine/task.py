@@ -114,7 +114,7 @@ class Task:
         self.is_source = isinstance(operator, SourceOperator)
         # liveness beat: updated every run-loop iteration / control poll /
         # backpressure wait; a hung task stops beating (Engine.heartbeat)
-        self.last_progress = time.monotonic()
+        self.last_progress = time.monotonic()  # concurrency: single-writer — monotonic heartbeat timestamp owned by the task thread; watchdog reads are GIL-atomic float snapshots and only ever see a slightly stale beat
         # epoch being snapshotted right now (None otherwise): an exception
         # mid-checkpoint stamps its OPERATOR_PANIC event with the epoch
         self._ckpt_epoch: Optional[int] = None

@@ -96,6 +96,15 @@ Instrumented sites:
                         here must recover to exactly one committed
                         lineage (the commits re-deliver cumulatively on
                         restart, COMMIT_REDELIVERED)
+    lock_contend        hold-time delay inside an instrumented critical
+                        section (obs/lockorder.py make_lock proxies; ctx:
+                        key="Class.attr"): ``delay=MS@match=<class>``
+                        widens the race window the concurrency auditor
+                        (LR4xx) flagged statically, so chaos tests can
+                        turn a suspected interleaving into a schedulable
+                        one. Locks are instrumented when constructed
+                        while a plan naming the site is installed (or the
+                        lock-order witness is enabled)
 """
 
 from __future__ import annotations
@@ -127,6 +136,7 @@ SITES = (
     "node.start_worker", "controller_rpc", "commit", "rescale",
     "autoscale_decide", "spill_write", "spill_probe", "spill_compact",
     "admission", "fleet_place", "job_tick", "evolve_drain", "evolve_cutover",
+    "lock_contend",
 )
 
 

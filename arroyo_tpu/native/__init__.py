@@ -342,7 +342,9 @@ class DataPlaneConn:
         self.fd = fd
         # one connection is shared by every sending task thread on this
         # worker pair; header+payload are two writes and must not interleave
-        self._send_lock = threading.Lock()
+        from ..obs.lockorder import make_lock  # lazy: keep native import-light
+
+        self._send_lock = make_lock("DataPlaneConn._send_lock")
 
     @staticmethod
     def connect(host: str, port: int, retries: int = 10, backoff_ms: int = 50) -> "DataPlaneConn":

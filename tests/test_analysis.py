@@ -367,16 +367,27 @@ def test_lr104_host_sync_hot_path():
     assert "LR104" not in ids_of(lint_source(host, "arroyo_tpu/operators/x.py"))
 
 
-def test_lr105_lock_across_blocking():
+def test_lr105_folded_into_lr403():
+    """LR105 is retired as a standalone rule: its intraprocedural shape
+    now fires as LR403 from the concurrency auditor (which lint_paths
+    runs alongside these rules); the old id survives only as a waiver
+    alias. See tests/test_concurrency_audit.py for the LR403 fixtures."""
+    from arroyo_tpu.analysis import CONCURRENCY_RULES
+    from arroyo_tpu.analysis.concurrency_audit import (
+        audit_concurrency_source,
+    )
+    from arroyo_tpu.analysis.repo_lint import RULES
+
+    assert "LR105" not in {rid for rid, _sev, _fn in RULES}
+    assert "LR403" in CONCURRENCY_RULES
     bad = (
         "import time\n"
         "def f(self):\n"
         "    with self._lock:\n"
         "        time.sleep(1)\n"
     )
-    assert "LR105" in ids_of(lint_source(bad, "arroyo_tpu/engine/x.py"))
-    sock = "def f(self):\n    with self._lock:\n        self.sock.sendall(b'x')\n"
-    assert "LR105" in ids_of(lint_source(sock, "arroyo_tpu/engine/x.py"))
+    assert "LR403" in {d.rule_id for d in audit_concurrency_source(
+        bad, "arroyo_tpu/engine/x.py")}
     # os.path.join / "".join under a lock are not thread joins
     path = (
         "import os\n"
@@ -384,17 +395,8 @@ def test_lr105_lock_across_blocking():
         "    with self._lock:\n"
         "        return os.path.join('a', 'b')\n"
     )
-    assert "LR105" not in ids_of(lint_source(path, "arroyo_tpu/engine/x.py"))
-    # nested defs execute later, outside the region
-    deferred = (
-        "import time\n"
-        "def f(self):\n"
-        "    with self._lock:\n"
-        "        def later():\n"
-        "            time.sleep(1)\n"
-        "        return later\n"
-    )
-    assert "LR105" not in ids_of(lint_source(deferred, "arroyo_tpu/engine/x.py"))
+    assert "LR403" not in {d.rule_id for d in audit_concurrency_source(
+        path, "arroyo_tpu/engine/x.py")}
 
 
 def test_lr106_fault_site_coverage():

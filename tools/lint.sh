@@ -19,8 +19,8 @@
 #                              assert every LR/AR rule id registered in the
 #                              analysis engines (repo_lint.RULES,
 #                              state_audit.RULES, trace_audit.RULES,
-#                              plan-pass AR literals) appears in the README
-#                              rule tables
+#                              concurrency_audit.RULES, plan-pass AR
+#                              literals) appears in the README rule tables
 #
 #   LINT_SARIF=findings.sarif tools/lint.sh
 #                              additionally write the lint findings as a
@@ -136,13 +136,14 @@ if [[ "${1:-}" == "--rules-catalog" ]]; then
     python - <<'EOF'
 import ast, re, sys
 
-from arroyo_tpu.analysis import AUDIT_RULES, LINT_RULES, TRACE_RULES
+from arroyo_tpu.analysis import (AUDIT_RULES, CONCURRENCY_RULES, LINT_RULES,
+                                 TRACE_RULES)
 
-# every rule id an analysis engine can emit: the three registered rule
+# every rule id an analysis engine can emit: the four registered rule
 # tables, plus AR-series literals AST-walked out of the plan passes (they
 # register by function, not id) — each must appear in a README rule table
 rule_ids = {rid for rid, _sev, _fn in LINT_RULES} | set(AUDIT_RULES) \
-    | set(TRACE_RULES)
+    | set(TRACE_RULES) | set(CONCURRENCY_RULES)
 ID_RE = re.compile(r"^(AR|LR)\d{3}$")
 for p in ("arroyo_tpu/analysis/plan_passes.py",
           "arroyo_tpu/analysis/plan_diff.py",
