@@ -624,13 +624,13 @@ def lint_source(source: str, relpath: str) -> list[Diagnostic]:
 
 def _site_literals(tree: ast.AST) -> set[str]:
     # sites reach fault_point either directly or through a module's guarded
-    # gateway (storage.py's _guarded, spill.py's _write_run), which takes
-    # the site as its first argument
+    # gateway (storage.py's _guarded/_guarded_v, spill.py's _write_run),
+    # which takes the site as its first argument
     out = set()
     for n in ast.walk(tree):
         if isinstance(n, ast.Call) \
-                and _call_name(n) in ("fault_point", "_guarded", "_write_run",
-                                      "_encode_and_write") \
+                and _call_name(n) in ("fault_point", "_guarded", "_guarded_v",
+                                      "_write_run", "_encode_and_write") \
                 and n.args:
             a = n.args[0]
             if isinstance(a, ast.Constant) and isinstance(a.value, str):

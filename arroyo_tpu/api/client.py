@@ -158,6 +158,18 @@ class ArroyoClient:
         state and last decision under the ``autoscaler`` key."""
         return self._req("GET", f"/api/v1/jobs/{job_id}/health")
 
+    def job_fsck(self, job_id: str,
+                 storage_url: "Optional[str]" = None) -> dict:
+        """Offline checkpoint-chain verification: FS-series diagnostics
+        over every epoch's artifacts; ``clean`` is False iff any ERROR
+        finding (same predicate as the `fsck` CLI's exit code)."""
+        suffix = ""
+        if storage_url:
+            from urllib.parse import quote
+
+            suffix = f"?storage_url={quote(storage_url, safe='')}"
+        return self._req("GET", f"/api/v1/jobs/{job_id}/fsck{suffix}")
+
     def fleet_status(self) -> dict:
         """Multi-tenant fleet snapshot: pool occupancy, per-tenant usage,
         and the admission queue with positions."""

@@ -293,6 +293,22 @@ def spec() -> dict:
                            "autoscaler's rail state and last decision",
                            ["job_id"],
                            response=JOB_HEALTH)},
+            "/api/v1/jobs/{job_id}/fsck": {
+                "get": _op("job_fsck", "offline checkpoint-chain "
+                           "verification: marker checksums, sidecar and "
+                           "table-file envelopes, spill-run liveness, "
+                           "evolution-mapping pairing, orphans — FS-series "
+                           "diagnostics; clean is false iff any ERROR",
+                           ["job_id"],
+                           response={"type": "object", "properties": {
+                               "job_id": _STR,
+                               "storage_url": _STR,
+                               "clean": {"type": "boolean"},
+                               "diagnostics": {"type": "array", "items": {
+                                   "type": "object", "properties": {
+                                       "rule": _STR, "severity": _STR,
+                                       "site": _STR, "message": _STR,
+                                       "hint": _STR}}}}})},
             "/api/v1/fleet": {
                 "get": _op("fleet_status", "multi-tenant fleet snapshot: "
                            "pool occupancy, per-tenant usage, and the "

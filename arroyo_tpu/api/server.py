@@ -111,6 +111,7 @@ class ApiServer:
         ("GET", r"^/api/v1/jobs/([^/]+)/traces$", "_job_traces"),
         ("GET", r"^/api/v1/jobs/([^/]+)/events$", "_job_events"),
         ("GET", r"^/api/v1/jobs/([^/]+)/health$", "_job_health"),
+        ("GET", r"^/api/v1/jobs/([^/]+)/fsck$", "_job_fsck"),
         ("GET", r"^/api/v1/fleet$", "_fleet"),
         ("GET", r"^/api/v1/connectors$", "_connectors"),
         ("POST", r"^/api/v1/connection_profiles$", "_create_profile"),
@@ -542,6 +543,31 @@ class ApiServer:
         detail = self.db.get_health(jid) or {
             "state": job.get("health") or "ok", "rules": []}
         h._json(200, {"job_id": jid, **detail})
+
+    def _job_fsck(self, h, jid):
+        """Offline checkpoint-chain verification (state.integrity.fsck_job):
+        walks every epoch's artifacts — marker checksum, sidecar and
+        table-file envelopes, spill-run liveness and footers,
+        evolution-mapping pairing, orphans — and returns the FS-series
+        diagnostics. ``clean`` is False iff any ERROR finding exists (the
+        same predicate as `arroyo_tpu fsck`'s exit code);
+        ``?storage_url=`` overrides the configured checkpoint store."""
+        from urllib.parse import parse_qs
+
+        from ..analysis import Severity
+        from ..config import config
+        from ..state.integrity import fsck_job
+
+        q = parse_qs(h.path.split("?", 1)[1]) if "?" in h.path else {}
+        storage_url = (q["storage_url"][0] if q.get("storage_url")
+                       else str(config().get("checkpoint.storage-url")))
+        diags = fsck_job(storage_url, jid)
+        h._json(200, {
+            "job_id": jid,
+            "storage_url": storage_url,
+            "clean": not any(d.severity == Severity.ERROR for d in diags),
+            "diagnostics": [d.to_dict() for d in diags],
+        })
 
     def _job_metrics(self, h, jid):
         # DB-persisted snapshots (shipped from workers over the control

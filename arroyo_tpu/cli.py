@@ -134,6 +134,34 @@ def _cmd_lint(args) -> int:
     return 0
 
 
+def _cmd_fsck(args) -> int:
+    """Offline checkpoint-chain verifier (disaster-recovery fsck): walk every
+    epoch of the job under the checkpoint store — marker completeness and
+    checksum, sidecar and table-file envelopes, spill-run liveness and
+    footers, evolution-mapping pairing, orphans — and print the shared
+    diagnostic report (--json / --sarif for CI). Exit 0 = the chain is
+    restorable (warnings allowed), 1 = at least one artifact is corrupt,
+    torn, or missing (FS-series ERROR)."""
+    from arroyo_tpu.analysis import (Severity, render_json, render_report,
+                                     render_sarif)
+    from arroyo_tpu.config import config
+    from arroyo_tpu.state.integrity import fsck_job
+
+    storage_url = args.storage_url or str(config().get("checkpoint.storage-url"))
+    diags = fsck_job(storage_url, args.job_id)
+    if args.sarif:
+        print(render_sarif(diags))
+    elif args.json:
+        print(render_json(diags))
+    elif diags:
+        print(render_report(diags))
+    if any(d.severity == Severity.ERROR for d in diags):
+        return 1
+    if not diags and not args.json and not args.sarif:
+        print(f"fsck clean: job {args.job_id} checkpoint chain verified")
+    return 0
+
+
 def _cmd_run(args) -> int:
     import arroyo_tpu
     from arroyo_tpu.api import ApiServer
@@ -823,6 +851,21 @@ def main(argv: Optional[list[str]] = None) -> int:
                     help="SARIF 2.1.0 diagnostics for CI inline "
                          "annotations; exit codes unchanged")
     lp.set_defaults(fn=_cmd_lint)
+
+    fp = sub.add_parser("fsck", help="offline checkpoint-chain verifier: "
+                                     "checksums, completeness, spill-run "
+                                     "liveness, orphans (FS-series rules)")
+    fp.add_argument("job_id", help="job whose checkpoint chain to verify")
+    fp.add_argument("--storage-url", default=None,
+                    help="checkpoint store prefix (default: "
+                         "checkpoint.storage-url from config)")
+    fp.add_argument("--json", action="store_true",
+                    help="machine-readable diagnostics (rule, severity, "
+                         "site, message, hint); exit codes unchanged")
+    fp.add_argument("--sarif", action="store_true",
+                    help="SARIF 2.1.0 diagnostics for CI inline "
+                         "annotations; exit codes unchanged")
+    fp.set_defaults(fn=_cmd_fsck)
 
     cs = sub.add_parser("compile-service",
                         help="standalone native-UDF compile service")

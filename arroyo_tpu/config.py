@@ -119,6 +119,12 @@ _DEFAULTS: dict[str, Any] = {
             # does not trigger a new spill immediately)
             "headroom": 0.75,
         },
+        # checkpoint-artifact checksum verification (state/tables.py,
+        # state/integrity.py): "restore" verifies envelopes only on the
+        # restore path (the read that matters for correctness), "always"
+        # also verifies hot reads (spill probes, compaction inputs),
+        # "off" trusts storage end to end
+        "integrity": {"verify": "restore"},
     },
     "storage": {
         # shared resilience layer (utils/retry.py) for object-store ops

@@ -31,7 +31,7 @@ def run_broker_source(
     broker sources)."""
     from ..formats.registry import make_deserializer
 
-    de = make_deserializer(cfg, schema)
+    de = make_deserializer(cfg, schema, task_info=sctx.ctx.task_info)
     last_sent = time.monotonic()
 
     def flush():

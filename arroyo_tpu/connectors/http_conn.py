@@ -70,7 +70,7 @@ class SSESource(SourceOperator):
         resp = conn.getresponse()
         if resp.status != 200:
             raise RuntimeError(f"SSE endpoint returned {resp.status}")
-        de = make_deserializer(self.cfg, self.schema)
+        de = make_deserializer(self.cfg, self.schema, task_info=ctx.task_info)
         # short socket timeout so control messages are polled between reads
         # (close-delimited responses detach conn.sock -> reach it via resp.fp)
         sock = conn.sock if conn.sock is not None else resp.fp.raw._sock
@@ -180,7 +180,7 @@ class PollingHTTPSource(SourceOperator):
         ctx = sctx.ctx
         if ctx.task_info.subtask_index != 0:
             return SourceFinishType.GRACEFUL
-        de = make_deserializer(self.cfg, self.schema)
+        de = make_deserializer(self.cfg, self.schema, task_info=ctx.task_info)
         framing = default_framing(self.cfg) or "newline"
         last_body: Optional[bytes] = None
         polls = 0
@@ -210,8 +210,10 @@ class PollingHTTPSource(SourceOperator):
             try:
                 with urllib.request.urlopen(req, timeout=10) as resp:
                     body = resp.read()
-            except Exception:
-                if str(self.cfg.get("bad_data", "fail")) == "drop":
+            except Exception as exc:
+                # transport errors go through the SAME bad_data policy as
+                # decode errors — counted and surfaced, never silently eaten
+                if de.drop_bad_data(exc):
                     continue
                 raise
             if self.emit_behavior == "changed" and body == last_body:

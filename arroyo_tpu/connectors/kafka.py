@@ -136,7 +136,7 @@ class KafkaSource(SourceOperator):
                 tp.offset = pos
             assignments.append(tp)
         consumer.assign(assignments)
-        de = make_deserializer(self.cfg, self.schema)
+        de = make_deserializer(self.cfg, self.schema, task_info=ctx.task_info)
         try:
             while True:
                 msg = sctx.poll_control()

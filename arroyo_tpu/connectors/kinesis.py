@@ -239,7 +239,7 @@ class KinesisSource(SourceOperator):
             first_list = False
 
         assign_shards()
-        de = make_deserializer(self.cfg, self.schema)
+        de = make_deserializer(self.cfg, self.schema, task_info=ctx.task_info)
 
         def flush():
             b = de.flush()

@@ -274,7 +274,8 @@ class RabbitmqSource(SourceOperator):
         client.queue_declare(self.queue)
         client.consume(self.queue)
         client.sock.settimeout(0.2)
-        de = make_deserializer(self.cfg, self.schema)
+        de = make_deserializer(self.cfg, self.schema,
+                               task_info=sctx.ctx.task_info)
         pending_tags: list[int] = []        # delivered since the last barrier
         tags_by_epoch: dict[int, list[int]] = {}  # barrier-taken, ack on commit
         ka_interval = client.heartbeat / 2 if client.heartbeat else 20.0

@@ -51,7 +51,7 @@ class SingleFileSource(SourceOperator):
         offset = tbl.get(sub, 0)
         from ..formats.registry import make_deserializer
 
-        de = make_deserializer(self.cfg, self.schema)
+        de = make_deserializer(self.cfg, self.schema, task_info=ctx.task_info)
         with open(self.path) as f:
             lines = f.read().splitlines()
         # deterministic split across subtasks: round-robin by line number

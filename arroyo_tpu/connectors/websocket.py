@@ -210,7 +210,7 @@ class WebSocketSource(SourceOperator):
         if self.subscription:
             sock.sendall(encode_frame(OP_TEXT, str(self.subscription).encode(), mask=True))
         sock.settimeout(0.2)
-        de = make_deserializer(self.cfg, self.schema)
+        de = make_deserializer(self.cfg, self.schema, task_info=ctx.task_info)
         while True:
             msg = sctx.poll_control()
             if msg is not None:

@@ -84,6 +84,10 @@ class CheckpointState:
     started_at: float
     acked: set = field(default_factory=set)
     publishing: bool = False  # metadata write claimed (single-writer guard)
+    # per-epoch integrity manifest, accumulated from the envelopes each
+    # subtask ack relays ({"operator-<node>/<file>": {crc,len,algo}}) and
+    # folded into the job-level marker at publish time
+    integrity: dict = field(default_factory=dict)
 
     def covered_by(self, finished: set, expected: frozenset) -> bool:
         """Global coverage: every expected subtask either acked this epoch
@@ -125,15 +129,20 @@ class CheckpointCoordinator:
                 self.pending.setdefault(
                     epoch, CheckpointState(epoch, time.monotonic()))
 
-    def on_ack(self, epoch: int, key: SubtaskKey) -> Optional[int]:
-        """Record one subtask's checkpoint-completed ack. Returns the epoch
-        if this ack made it globally durable (metadata marker written)."""
+    def on_ack(self, epoch: int, key: SubtaskKey,
+               integrity: Optional[dict] = None) -> Optional[int]:
+        """Record one subtask's checkpoint-completed ack (``integrity`` is
+        its artifact-envelope contribution to the epoch manifest). Returns
+        the epoch if this ack made it globally durable (metadata marker
+        written)."""
         with self._lock:
             if epoch in self.forgotten or epoch in self.durable:
                 return None  # late ack for a subsumed or already-durable epoch
             st = self.pending.setdefault(
                 epoch, CheckpointState(epoch, time.monotonic()))
             st.acked.add(key)
+            if integrity:
+                st.integrity.update(integrity)
             self.event_log.append(("subtask_acked", epoch, key[0], key[1]))
             if st.publishing or not st.covered_by(self.finished, self.expected):
                 return None
@@ -166,6 +175,8 @@ class CheckpointCoordinator:
         extra = {"operators": operators}
         if self.plan_hash:
             extra["plan_hash"] = self.plan_hash
+        if st.integrity:
+            extra["integrity"] = dict(sorted(st.integrity.items()))
         write_job_checkpoint_metadata(
             self.storage_url, self.job_id, st.epoch, extra)
         trace_recorder.record(self.job_id, st.epoch, "metadata_durable")
@@ -262,7 +273,8 @@ class EngineSetCoordinator:
     def _handle(self, ev: dict) -> None:
         if ev.get("event") == "subtask_acked":
             durable = self.coordinator.on_ack(
-                int(ev["epoch"]), (ev["node"], int(ev["subtask"])))
+                int(ev["epoch"]), (ev["node"], int(ev["subtask"])),
+                integrity=ev.get("integrity"))
             if durable is not None:
                 self._commit(durable)
         elif ev.get("event") == "subtask_finished":

@@ -28,7 +28,6 @@ from ..config import config
 from ..obs import trace as obs_trace
 from ..obs.events import recorder as events_recorder
 from ..obs.health import HealthMonitor, health_event_code
-from ..state.tables import latest_complete_checkpoint
 from .autoscaler import Autoscaler
 from .db import Database
 from .fleet import FleetManager, demand_slots
@@ -165,6 +164,32 @@ class JobController:
                            "tick", self.job_id)
             return
         self._events_flushed_seq = evs[-1]["seq"]
+
+    def _pick_restore_epoch(self) -> Optional[int]:
+        """The restore fallback ladder: verify-before-load. The newest
+        complete epoch that passes integrity verification wins; corrupt or
+        incomplete ones are QUARANTINED (marker preserved, never deleted —
+        GC refuses them until an operator resolves) and the walk falls
+        back to the next-older valid epoch. Sources rewind to the chosen
+        epoch's checkpointed offsets, so replay covers the gap."""
+        from ..state.integrity import latest_valid_checkpoint
+
+        def on_quarantine(epoch: int, reason: str) -> None:
+            self._event("ERROR", "CHECKPOINT_QUARANTINED",
+                        f"checkpoint epoch {epoch} failed integrity "
+                        f"verification and was quarantined: {reason[:400]}",
+                        epoch=epoch, data={"reason": reason[:800]})
+
+        epoch, skipped = latest_valid_checkpoint(
+            self.storage_url, self.job_id, on_quarantine=on_quarantine)
+        if skipped:
+            self._event("WARN", "RESTORE_FELL_BACK",
+                        f"restore fell back to epoch {epoch or 0} past "
+                        f"{len(skipped)} quarantined epoch(s); sources "
+                        f"rewind and replay covers the gap byte-exactly",
+                        epoch=epoch,
+                        data={"fallback_epoch": epoch, "skipped": skipped})
+        return epoch
 
     def _on_health_transition(self, old: str, new: str, detail: dict) -> None:
         firing = [{"rule": r["rule"], "value": r["value"],
@@ -318,7 +343,7 @@ class JobController:
             if not self.fleet.holds(self.job_id) \
                     and not self._admit_or_queue(job):
                 return
-            self.restore_epoch = latest_complete_checkpoint(self.storage_url, self.job_id)
+            self.restore_epoch = self._pick_restore_epoch()
             self._event("WARN", "RESTORE",
                         f"restoring worker set from epoch "
                         f"{self.restore_epoch or 0} (restart {self.restarts})",
@@ -352,7 +377,7 @@ class JobController:
         # the transition is over: the ledger settles on the final demand
         # (a scale-down frees slots for the next admission pass)
         self.fleet.set_demand(self.job_id, self._demand())
-        self.restore_epoch = latest_complete_checkpoint(self.storage_url, self.job_id)
+        self.restore_epoch = self._pick_restore_epoch()
         self._event("WARN", "RESTORE",
                     f"restoring worker set from epoch "
                     f"{self.restore_epoch or 0} at parallelism "
@@ -375,8 +400,7 @@ class JobController:
         fresh = self.db.get_job(self.job_id) or job
         new_sql = fresh.get("desired_query") or self.evolve_to
         self.evolve_to = None
-        self.restore_epoch = latest_complete_checkpoint(
-            self.storage_url, self.job_id)
+        self.restore_epoch = self._pick_restore_epoch()
         if not new_sql or new_sql == self.sql:
             # request withdrawn (or no-op) between pickup and drain end:
             # the drained set just restarts unchanged
@@ -551,8 +575,7 @@ class JobController:
         self.fleet.clear_backoff(self.job_id)
         # a preempted (or 409-bounced) job resumes from its freshest
         # checkpoint; a first-time job has none and starts clean
-        self.restore_epoch = latest_complete_checkpoint(
-            self.storage_url, self.job_id)
+        self.restore_epoch = self._pick_restore_epoch()
         self._set_state(JobState.SCHEDULING,
                         restore_epoch=self.restore_epoch)
 
@@ -1079,7 +1102,8 @@ class JobController:
                     self._epoch_durable(int(ev["epoch"]))
                 elif kind == "subtask_acked" and self.coordinator is not None:
                     durable = self.coordinator.on_ack(
-                        int(ev["epoch"]), (ev["node"], int(ev["subtask"])))
+                        int(ev["epoch"]), (ev["node"], int(ev["subtask"])),
+                        integrity=ev.get("integrity"))
                     if durable is not None:
                         self._epoch_durable(durable)
                 elif kind == "subtask_finished" and self.coordinator is not None:

@@ -518,9 +518,16 @@ class Engine:
                     ep = self._checkpoints.setdefault(resp.epoch, {})
                     ep[key] = resp.subtask_metadata
                     if self.coordinated:
+                        from ..state.integrity import fold_integrity
+
+                        # the subtask's artifact envelopes ride the ack so
+                        # the controller's marker can fold the per-epoch
+                        # integrity manifest without re-reading storage
                         self.coordinator_events.put({
                             "event": "subtask_acked", "epoch": resp.epoch,
                             "node": key[0], "subtask": key[1],
+                            "integrity": fold_integrity(
+                                [resp.subtask_metadata or {}]),
                         })
                     self._finish_ready_epochs()
                 self._cond.notify_all()
@@ -547,6 +554,11 @@ class Engine:
                 extra = {"operators": list({k[0] for k in ep})}
                 if self.plan_hash:
                     extra["plan_hash"] = self.plan_hash
+                from ..state.integrity import fold_integrity
+
+                integ = fold_integrity(m for m in ep.values() if m)
+                if integ:
+                    extra["integrity"] = integ
                 write_job_checkpoint_metadata(
                     self.storage_url, self.job_id, epoch, extra,
                 )

@@ -25,7 +25,14 @@ Instrumented sites:
     storage.put / storage.get / storage.delete / storage.list
                         object-store ops (ctx: key=path); retried by the
                         shared retry layer, so transient actions recover
-                        without a job restart
+                        without a job restart. put/get additionally honor
+                        ``corrupt=bitflip|truncate@match=<path-substr>``:
+                        the bytes in flight are deterministically damaged
+                        (put = persistent corruption like a truncated
+                        upload; get = read-side bit rot) so chaos tests
+                        can prove the integrity envelope detects every
+                        corruption class and restore quarantines + falls
+                        back instead of loading garbage
     storage.multipart   per-part S3 multipart upload (ctx: key, part)
     network.send        data-plane frame send (ctx: key="e,s->n,d" quad,
                         worker); drop/dup/delay/partition

@@ -30,6 +30,12 @@ Sites are dotted names named by the instrumented call sites (see
     force=V     tell the call site to substitute the value V for whatever
                 it was about to use (site-specific: e.g. autoscale_decide
                 forces a bogus target parallelism the rails must clamp)
+    corrupt=M   tell the call site to corrupt the bytes in flight, M one of
+                ``bitflip`` (flip one bit of the middle byte) or
+                ``truncate`` (keep the first half) — the storage data paths
+                apply it to puts (persistent corruption, like a truncated
+                upload) and gets (read-side corruption, like bit rot);
+                pair with ``@match=<path-substr>`` to hit one artifact
 
 Conditions restrict when a spec matches. ``match=SUBSTR`` tests substring
 containment against the call's ``key`` context (paths, shard ids, quads);
@@ -61,7 +67,11 @@ _log = logging.getLogger("arroyo_tpu.faults")
 # the call site applies itself (drop/dup/force) or that the injector
 # applies inline (delay/hang)
 _RAISING = ("fail", "fail_once", "fail_n", "crash", "partition")
-_KNOWN_ACTIONS = _RAISING + ("drop", "dup", "delay", "hang", "force")
+_KNOWN_ACTIONS = _RAISING + ("drop", "dup", "delay", "hang", "force",
+                             "corrupt")
+
+# corrupt=<mode> carries a string arg (the corruption mode), not a number
+CORRUPT_MODES = ("bitflip", "truncate")
 
 
 class InjectedFault(RuntimeError):
@@ -96,13 +106,16 @@ class InjectedPartition(ConnectionError):
 class FaultSpec:
     site: str
     action: str
-    arg: Optional[float] = None
+    arg: Optional[object] = None  # float, or str for corrupt=<mode>
     conds: dict = field(default_factory=dict)
     hits: int = 0   # calls matching the non-ordinal conditions
     fired: int = 0  # times this spec actually fired
 
     def describe(self) -> str:
-        a = self.action + (f"={self.arg:g}" if self.arg is not None else "")
+        a = self.action
+        if self.arg is not None:
+            a += (f"={self.arg:g}" if isinstance(self.arg, float)
+                  else f"={self.arg}")
         c = "&".join(f"{k}={v}" for k, v in self.conds.items())
         return f"{self.site}:{a}" + (f"@{c}" if c else "")
 
@@ -126,15 +139,23 @@ def parse_plan(plan: str) -> list[FaultSpec]:
         action, arg = rest, None
         if "=" in rest:
             action, args = rest.split("=", 1)
-            try:
-                arg = float(args)
-            except ValueError as e:
-                raise PlanSyntaxError(f"fault spec {raw!r}: bad arg {args!r}") from e
+            if action == "corrupt":
+                if args not in CORRUPT_MODES:
+                    raise PlanSyntaxError(
+                        f"fault spec {raw!r}: corrupt mode must be one of "
+                        f"{', '.join(CORRUPT_MODES)}")
+                arg = args
+            else:
+                try:
+                    arg = float(args)
+                except ValueError as e:
+                    raise PlanSyntaxError(f"fault spec {raw!r}: bad arg {args!r}") from e
         if action not in _KNOWN_ACTIONS:
             raise PlanSyntaxError(
                 f"fault spec {raw!r}: unknown action {action!r} "
                 f"(have: {', '.join(_KNOWN_ACTIONS)})")
-        if action in ("fail_n", "delay", "hang", "force") and arg is None:
+        if action in ("fail_n", "delay", "hang", "force", "corrupt") \
+                and arg is None:
             raise PlanSyntaxError(f"fault spec {raw!r}: {action} needs =ARG")
         conds: dict = {}
         if cond_str:

@@ -20,8 +20,21 @@ class BadDataError(ValueError):
     pass
 
 
+# BAD_DATA_DROPPED events are throttled per deserializer so a poisoned topic
+# can't flood the event log; the metric counter stays exact regardless
+_DROP_EVENT_INTERVAL_S = 30.0
+
+
 class RowBatchingDeserializer:
-    """Accumulates decoded rows, flushing by batch size / linger."""
+    """Accumulates decoded rows, flushing by batch size / linger.
+
+    Owns the ``bad_data = fail | drop`` policy for EVERY connector:
+    decode errors hit it in :meth:`deserialize`, and connectors route
+    transport-level record errors through :meth:`drop_bad_data` instead of
+    reimplementing the option inline, so drops are counted
+    (``arroyo_bad_records_total``) and surfaced (``BAD_DATA_DROPPED``)
+    uniformly no matter which layer rejected the record.
+    """
 
     def __init__(
         self,
@@ -30,15 +43,19 @@ class RowBatchingDeserializer:
         linger_micros: int = 100_000,
         bad_data: str = "fail",
         event_time_field: Optional[str] = None,
+        task_info=None,
     ):
         self.schema = schema
         self.batch_size = batch_size
         self.linger_micros = linger_micros
         self.bad_data = bad_data
         self.event_time_field = event_time_field
+        self.task_info = task_info
         self._rows: list[dict] = []
         self._first_buffer_time: Optional[float] = None
         self.errors = 0
+        self._drops_unreported = 0
+        self._last_drop_event: Optional[float] = None
 
     # -- subclass hook -------------------------------------------------------
 
@@ -46,14 +63,46 @@ class RowBatchingDeserializer:
         """payload (bytes/str) -> row dicts; raise on malformed input."""
         raise NotImplementedError
 
+    # -- bad-data policy -----------------------------------------------------
+
+    def drop_bad_data(self, err: Exception) -> bool:
+        """The one ``bad_data`` decision point. Returns True when the record
+        was dropped (policy ``drop``; drop recorded), False when the caller
+        must re-raise (policy ``fail``)."""
+        if self.bad_data != "drop":
+            return False
+        self.errors += 1
+        ti = self.task_info
+        if ti is None:
+            return True
+        from ..metrics import registry
+
+        registry.add_bad_record(ti.job_id, ti.node_id)
+        self._drops_unreported += 1
+        now = time.monotonic()
+        if (self._last_drop_event is None
+                or now - self._last_drop_event >= _DROP_EVENT_INTERVAL_S):
+            from ..obs.events import recorder
+
+            recorder.record(
+                ti.job_id, "WARN", "BAD_DATA_DROPPED",
+                f"dropped {self._drops_unreported} bad record(s) under "
+                f"bad_data=drop: {str(err)[:200]}",
+                node=ti.node_id, subtask=ti.subtask_index,
+                data={"dropped": self._drops_unreported,
+                      "total_dropped": self.errors,
+                      "last_error": str(err)[:400]})
+            self._drops_unreported = 0
+            self._last_drop_event = now
+        return True
+
     # -- public API ----------------------------------------------------------
 
     def deserialize(self, payload, timestamp_micros: Optional[int] = None) -> None:
         try:
             rows = self._decode(payload)
-        except Exception:
-            if self.bad_data == "drop":
-                self.errors += 1
+        except Exception as exc:
+            if self.drop_bad_data(exc):
                 return
             raise
         if not rows:

@@ -65,8 +65,14 @@ class DebeziumJsonDeserializer(JsonDeserializer):
         return rows
 
 
-def make_deserializer(cfg: dict, schema: Schema) -> RowBatchingDeserializer:
-    """Build the configured deserializer for a source node config."""
+def make_deserializer(cfg: dict, schema: Schema,
+                      task_info=None) -> RowBatchingDeserializer:
+    """Build the configured deserializer for a source node config.
+
+    ``task_info`` (types.TaskInfo) attributes dropped records to a
+    job/operator for the ``arroyo_bad_records_total`` counter and the
+    throttled ``BAD_DATA_DROPPED`` event; without it drops are only
+    counted on the deserializer itself."""
     from ..config import config
 
     fmt = str(cfg.get("format", "json"))
@@ -76,6 +82,7 @@ def make_deserializer(cfg: dict, schema: Schema) -> RowBatchingDeserializer:
         linger_micros=config().get("pipeline.source-batch-linger-ms", 100) * 1000,
         bad_data=str(cfg.get("bad_data", "fail")),
         event_time_field=cfg.get("event_time_field"),
+        task_info=task_info,
     )
     if fmt == "json":
         return JsonDeserializer(

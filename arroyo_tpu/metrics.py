@@ -214,6 +214,10 @@ class MetricsRegistry:
         # multi-tenant fleet snapshot (controller/fleet.py stats()), set
         # once per ControllerServer tick; None until a fleet pass ran
         self._fleet: Optional[dict] = None
+        # (job_id, operator) -> records dropped under bad_data=drop; fed by
+        # the shared deserializer policy (formats/base.py) so every
+        # connector counts drops identically
+        self._bad_records: dict[tuple[str, str], int] = {}
 
     def set_job_health(self, job_id: str, state: str) -> None:
         with self._lock:
@@ -226,6 +230,17 @@ class MetricsRegistry:
     def set_fleet_stats(self, stats: Optional[dict]) -> None:
         with self._lock:
             self._fleet = stats
+
+    def add_bad_record(self, job_id: str, operator: str, n: int = 1) -> None:
+        key = (job_id, operator)
+        with self._lock:
+            self._bad_records[key] = self._bad_records.get(key, 0) + int(n)
+
+    def bad_records(self, job_id: str) -> dict[str, int]:
+        """operator -> dropped-record count for one job (API/test probe)."""
+        with self._lock:
+            return {op: n for (j, op), n in self._bad_records.items()
+                    if j == job_id}
 
     def task(self, job_id: str, node_id: str, subtask: int) -> TaskMetrics:
         key = (job_id, node_id, subtask)
@@ -286,6 +301,9 @@ class MetricsRegistry:
             self._autoscaler_target.pop(job_id, None)
             self._segment_compile.pop(job_id, None)
             self._segment_cache_hits.pop(job_id, None)
+            self._bad_records = {
+                k: v for k, v in self._bad_records.items() if k[0] != job_id
+            }
 
     def prometheus_text(self) -> str:
         """Prometheus exposition format (served at /metrics)."""
@@ -470,6 +488,14 @@ class MetricsRegistry:
                            .replace('"', '\\"').replace("\n", "\\n"))
                     lines.append(
                         f'arroyo_fleet_queue_depth{{tenant="{esc}"}} {n}')
+        with self._lock:
+            bad = sorted(self._bad_records.items())
+        if bad:
+            lines.append("# TYPE arroyo_bad_records_total counter")
+            for (job, op), n in bad:
+                lines.append(
+                    f'arroyo_bad_records_total{{job="{job}",'
+                    f'operator="{op}"}} {n}')
         from .obs.events import recorder as _events_recorder
 
         counts = _events_recorder.counts_snapshot()

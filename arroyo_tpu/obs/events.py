@@ -128,6 +128,21 @@ EVENT_CODES: dict[str, tuple[str, str]] = {
     "JOB_EVOLVE_DONE": (
         "INFO", "the evolution finished: the evolved plan owns the single "
                 "committed lineage at its bumped pipeline version"),
+    "CHECKPOINT_QUARANTINED": (
+        "ERROR", "a checkpoint epoch failed integrity verification (torn/"
+                 "corrupt marker, sidecar, table file, or missing spill "
+                 "run) and was quarantined: its marker is preserved under "
+                 "metadata.json.quarantined, GC refuses the epoch, and an "
+                 "operator must resolve it (data: reason)"),
+    "RESTORE_FELL_BACK": (
+        "WARN", "restore skipped one or more quarantined epochs and fell "
+                "back to the next-older valid checkpoint; sources rewind "
+                "to that epoch's offsets so replay covers the gap (data: "
+                "skipped epochs with reasons, fallback epoch)"),
+    "BAD_DATA_DROPPED": (
+        "WARN", "a connector dropped undeserializable records under "
+                "bad_data=drop (throttled; data carries the drop count "
+                "since the last emission and the last error)"),
     "SPILL_STARTED": (
         "INFO", "tiered state engaged: a subtask's resident state passed "
                 "its budget and cold partitions began spilling to storage "

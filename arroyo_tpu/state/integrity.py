@@ -1,0 +1,498 @@
+"""Checkpoint integrity verification: the restore fallback ladder's
+valid-epoch selector and the offline ``fsck`` walker.
+
+Every durable state artifact carries an integrity envelope — table files
+and sidecars record ``(crc, len, algo)`` into the per-epoch manifest folded
+into the job-level ``metadata.json`` commit point; JSON artifacts (the
+marker itself, sidecars, evolution mappings, quarantine records) embed a
+self-checksum under ``__integrity__``; spill runs, which outlive the epoch
+whose manifest references them, carry a self-describing footer
+(``storage.wrap_footer``). This module is the read side:
+
+``verify_epoch``
+    decides whether one epoch is a safe restore target — marker parses and
+    checksums, every sidecar parses and checksums, every referenced table
+    file exists and matches its envelope, every referenced spill run
+    exists. Returns the list of problems (empty = valid).
+
+``latest_valid_checkpoint``
+    the fallback ladder: walk epochs newest -> oldest, QUARANTINE the
+    invalid ones (``tables.quarantine_epoch`` — renamed marker, never a
+    delete), return the newest epoch that verifies plus the list of
+    epochs skipped and why. Sources rewind automatically: offsets live in
+    the checkpointed global tables, so restoring an older epoch replays
+    the gap byte-exactly.
+
+``fsck_job``
+    the offline auditor behind ``arroyo_tpu fsck`` and
+    ``GET /api/v1/jobs/<id>/fsck``: walks the WHOLE chain (every epoch,
+    the "final" drained snapshot, spill runs, evolution mappings, orphan
+    files) and emits the shared Diagnostic model (FS-series rules).
+
+Compaction caveat: ``compact_operator`` rewrites sidecars and deletes
+merged-away shards, so the marker-folded manifest goes stale for any
+operator directory holding a generation>=1 entry. The sidecars are the
+authoritative envelope source from then on (they self-checksum and their
+``files`` entries carry fresh envelopes); the marker manifest is only
+enforced for uncompacted directories.
+
+FS rules:
+
+    FS001  torn epoch: directory without a parseable commit marker
+    FS002  commit marker fails its integrity checksum
+    FS003  quarantined epoch awaiting operator resolution
+    FS004  sidecar missing, unparseable, or failing its checksum
+    FS005  table file missing or failing its envelope
+    FS006  referenced spill run missing or failing its footer
+    FS007  evolution mapping unparseable, corrupt, or paired with the
+           wrong plan hash
+    FS008  orphan file no live metadata references
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Callable, Iterable, Optional
+
+from ..analysis.diagnostics import Diagnostic, Severity, finish
+from . import storage
+from .tables import (
+    QUARANTINE_MARKER,
+    QUARANTINED_METADATA,
+    checkpoint_dir,
+    is_quarantined,
+    load_json_with_integrity,
+    quarantine_epoch,
+)
+
+_log = logging.getLogger("arroyo_tpu.state")
+
+
+# ------------------------------------------------------------- manifest fold
+
+
+def fold_integrity(subtask_metas: Iterable[dict]) -> dict:
+    """Fold per-subtask checkpoint metadata (``TableManager.checkpoint``
+    return values) into the per-epoch integrity manifest the job-level
+    marker carries: ``{"operator-<node>/<file>": {"crc","len","algo"}}``.
+    Entries without an envelope (older writers) are skipped."""
+    manifest: dict[str, dict] = {}
+    for m in subtask_metas:
+        if not isinstance(m, dict) or "node_id" not in m:
+            continue
+        opd = f"operator-{m['node_id']}"
+        for fm in m.get("files", ()):
+            if isinstance(fm, dict) and fm.get("file") and "crc" in fm:
+                manifest[f"{opd}/{fm['file']}"] = {
+                    "crc": fm["crc"], "len": fm["len"],
+                    "algo": fm.get("algo", "crc32")}
+        sc = m.get("sidecar")
+        if isinstance(sc, dict) and sc.get("file") and "crc" in sc:
+            manifest[f"{opd}/{sc['file']}"] = {
+                "crc": sc["crc"], "len": sc["len"],
+                "algo": sc.get("algo", "crc32")}
+    return manifest
+
+
+# ----------------------------------------------------------- epoch walking
+
+
+def _epoch_tags(storage_url: str, job_id: str) -> list[int]:
+    """Numeric epoch tags present under the job's checkpoints dir."""
+    base = os.path.join(storage_url, job_id, "checkpoints")
+    if not storage.isdir(base):
+        return []
+    out = []
+    for fn in storage.listdir(base):
+        if fn.startswith("checkpoint-"):
+            tag = fn.split("-", 1)[1]
+            if tag.isdigit():
+                out.append(int(tag))
+    return sorted(out)
+
+
+def _read_marker(storage_url: str, job_id: str, epoch: int,
+                 verify: bool) -> tuple[Optional[dict], Optional[str]]:
+    """(marker, problem): marker is None when missing; problem is set when
+    the file exists but is torn or fails its checksum."""
+    path = os.path.join(checkpoint_dir(storage_url, job_id, epoch),
+                        "metadata.json")
+    if not storage.exists(path):
+        return None, None
+    try:
+        return load_json_with_integrity(
+            storage.read_text(path), path, verify), None
+    except Exception as e:  # noqa: BLE001 - every parse/crc failure counts
+        return None, f"commit marker {path} is torn or corrupt: {e}"
+
+
+def _spill_run_exists(storage_url: str, job_id: str, opd: str,
+                      run: str) -> bool:
+    return storage.exists(
+        os.path.join(storage_url, job_id, "spill", opd, run))
+
+
+def verify_epoch(storage_url: str, job_id: str, epoch: int,
+                 verify_checksums: bool = True) -> list[str]:
+    """Every reason ``epoch`` is NOT a safe restore target (empty list =
+    valid). Existence and parseability are always checked; byte-level
+    checksum verification is gated by ``verify_checksums`` (the ladder
+    passes ``tables._should_verify(True)`` so ``state.integrity.verify =
+    off`` keeps restores cheap; fsck always verifies)."""
+    problems: list[str] = []
+    marker, prob = _read_marker(storage_url, job_id, epoch, verify_checksums)
+    if prob:
+        return [prob]
+    if marker is None:
+        return [f"epoch {epoch} has no commit marker"]
+    manifest = marker.get("integrity") or {}
+    cdir = checkpoint_dir(storage_url, job_id, epoch)
+    for node in marker.get("operators", ()):
+        opd = f"operator-{node}"
+        d = os.path.join(cdir, opd)
+        if not storage.isdir(d):
+            # a subtask that DRAINED before the barrier writes nothing for
+            # the epoch — restore falls back to the "final" snapshot
+            # (TableManager.restore); only a dir the manifest promised
+            # artifacts for counts as missing
+            if any(k.startswith(opd + "/") for k in manifest):
+                problems.append(f"operator directory {opd} is missing")
+            continue
+        sidecars: list[tuple[str, dict]] = []
+        for fn in sorted(storage.listdir(d)):
+            if not (fn.startswith("metadata-") and fn.endswith(".json")):
+                continue
+            p = os.path.join(d, fn)
+            try:
+                sidecars.append((fn, load_json_with_integrity(
+                    storage.read_text(p), p, verify_checksums)))
+            except Exception as e:  # noqa: BLE001 - any failure disqualifies
+                problems.append(f"sidecar {opd}/{fn} is torn or corrupt: {e}")
+        if not sidecars and not problems:
+            problems.append(f"operator {node} has no checkpoint sidecars")
+        compacted = any(int(fm.get("generation", 0)) >= 1
+                        for _fn, m in sidecars for fm in m.get("files", ()))
+        for fn, m in sidecars:
+            rel = f"{opd}/{fn}"
+            env = manifest.get(rel)
+            if env and verify_checksums and not compacted:
+                try:
+                    storage.verify_envelope(
+                        storage.read_bytes(os.path.join(d, fn)), env,
+                        os.path.join(d, fn))
+                except storage.IntegrityError as e:
+                    problems.append(f"sidecar {rel} fails the epoch "
+                                    f"manifest envelope: {e.reason}")
+            for fm in m.get("files", ()):
+                fpath = os.path.join(d, fm["file"])
+                if not storage.exists(fpath):
+                    problems.append(f"table file {opd}/{fm['file']} "
+                                    "is missing")
+                    continue
+                if verify_checksums and "crc" in fm:
+                    try:
+                        storage.verify_envelope(
+                            storage.read_bytes(fpath), fm, fpath)
+                    except storage.IntegrityError as e:
+                        problems.append(f"table file {opd}/{fm['file']} "
+                                        f"fails its envelope: {e.reason}")
+                for run in fm.get("spill_runs", ()):
+                    if not _spill_run_exists(storage_url, job_id, opd, run):
+                        problems.append(
+                            f"spill run {opd}/{run} referenced by table "
+                            f"{fm.get('table')!r} is missing")
+    return problems
+
+
+# --------------------------------------------------------- fallback ladder
+
+
+def latest_valid_checkpoint(
+    storage_url: str, job_id: str,
+    on_quarantine: Optional[Callable[[int, str], None]] = None,
+) -> tuple[Optional[int], list[dict]]:
+    """The restore fallback ladder. Walk complete-looking epochs newest ->
+    oldest; an epoch that fails ``verify_epoch`` is QUARANTINED (marker
+    preserved under ``metadata.json.quarantined`` — never deleted; GC and
+    subsume refuse it until an operator resolves it) and the walk falls
+    back to the next-older epoch. Returns ``(epoch, skipped)`` where
+    ``skipped`` is ``[{"epoch", "reason"}, ...]`` for the RESTORE_FELL_BACK
+    event — empty when the newest epoch verified first try. ``epoch`` is
+    None when no valid epoch remains (fresh start).
+
+    ``on_quarantine(epoch, reason)`` fires after each quarantine so callers
+    can emit CHECKPOINT_QUARANTINED with storage state already consistent.
+    """
+    from .tables import _should_verify
+
+    verify_checksums = _should_verify(True)
+    skipped: list[dict] = []
+    for epoch in reversed(_epoch_tags(storage_url, job_id)):
+        if is_quarantined(storage_url, job_id, epoch):
+            continue
+        marker_path = os.path.join(
+            checkpoint_dir(storage_url, job_id, epoch), "metadata.json")
+        if not storage.exists(marker_path):
+            continue  # torn epoch: invisible to restore, subsume owns it
+        problems = verify_epoch(storage_url, job_id, epoch, verify_checksums)
+        if not problems:
+            return epoch, skipped
+        reason = "; ".join(problems[:5])
+        quarantine_epoch(storage_url, job_id, epoch, reason)
+        skipped.append({"epoch": epoch, "reason": reason})
+        if on_quarantine is not None:
+            on_quarantine(epoch, reason)
+    return None, skipped
+
+
+# ------------------------------------------------------------------- fsck
+
+
+def _fsck_epoch(storage_url: str, job_id: str, epoch: int,
+                diags: list[Diagnostic]) -> None:
+    site = f"{job_id}/checkpoints/checkpoint-{epoch:07d}"
+    if is_quarantined(storage_url, job_id, epoch):
+        diags.append(Diagnostic(
+            "FS003", Severity.WARNING, site,
+            f"epoch {epoch} is quarantined and awaits operator resolution",
+            hint="inspect metadata.json.quarantined + quarantine.json; "
+                 "delete the directory (or restore the marker) to resolve"))
+        return
+    marker, prob = _read_marker(storage_url, job_id, epoch, verify=True)
+    if prob:
+        diags.append(Diagnostic(
+            "FS002", Severity.ERROR, site, prob,
+            hint="quarantine-and-fall-back will skip this epoch on the "
+                 "next restore; resolve or delete it after forensics"))
+        return
+    if marker is None:
+        diags.append(Diagnostic(
+            "FS001", Severity.WARNING, site,
+            f"epoch {epoch} has no commit marker (torn mid-checkpoint)",
+            hint="harmless: invisible to restore; the controller watchdog "
+                 "subsumes torn epochs automatically"))
+        return
+    for p in verify_epoch(storage_url, job_id, epoch, verify_checksums=True):
+        rule = ("FS004" if "sidecar" in p
+                else "FS006" if "spill run" in p
+                else "FS005")
+        diags.append(Diagnostic(
+            rule, Severity.ERROR, site, p,
+            hint="restore would quarantine this epoch and fall back"))
+
+
+def _fsck_final(storage_url: str, job_id: str,
+                diags: list[Diagnostic]) -> None:
+    """The "final" drained-source snapshot dir verifies like an epoch's
+    operator dirs but has no commit marker of its own."""
+    cdir = checkpoint_dir(storage_url, job_id, "final")
+    if not storage.isdir(cdir):
+        return
+    site = f"{job_id}/checkpoints/checkpoint-final"
+    for opd in sorted(storage.listdir(cdir)):
+        d = os.path.join(cdir, opd)
+        if not opd.startswith("operator-") or not storage.isdir(d):
+            continue
+        for fn in sorted(storage.listdir(d)):
+            if not (fn.startswith("metadata-") and fn.endswith(".json")):
+                continue
+            p = os.path.join(d, fn)
+            try:
+                m = load_json_with_integrity(storage.read_text(p), p, True)
+            except Exception as e:  # noqa: BLE001 - report, keep walking
+                diags.append(Diagnostic(
+                    "FS004", Severity.ERROR, site,
+                    f"sidecar {opd}/{fn} is torn or corrupt: {e}"))
+                continue
+            for fm in m.get("files", ()):
+                fpath = os.path.join(d, fm["file"])
+                if not storage.exists(fpath):
+                    diags.append(Diagnostic(
+                        "FS005", Severity.ERROR, site,
+                        f"table file {opd}/{fm['file']} is missing"))
+                elif "crc" in fm:
+                    try:
+                        storage.verify_envelope(
+                            storage.read_bytes(fpath), fm, fpath)
+                    except storage.IntegrityError as e:
+                        diags.append(Diagnostic(
+                            "FS005", Severity.ERROR, site,
+                            f"table file {opd}/{fm['file']} fails its "
+                            f"envelope: {e.reason}"))
+
+
+def _fsck_evolutions(storage_url: str, job_id: str, epochs: list[int],
+                     diags: list[Diagnostic]) -> None:
+    base = os.path.join(storage_url, job_id, "checkpoints")
+    if not storage.isdir(base):
+        return
+    for fn in sorted(storage.listdir(base)):
+        if not (fn.startswith("evolution-") and fn.endswith(".json")):
+            continue
+        site = f"{job_id}/checkpoints/{fn}"
+        tag = fn[len("evolution-"):-len(".json")]
+        p = os.path.join(base, fn)
+        try:
+            mapping = load_json_with_integrity(storage.read_text(p), p, True)
+        except Exception as e:  # noqa: BLE001 - report, keep walking
+            diags.append(Diagnostic(
+                "FS007", Severity.ERROR, site,
+                f"evolution mapping is torn or corrupt: {e}",
+                hint="re-run the evolve API so the plan-diff pass rewrites "
+                     "the proven mapping"))
+            continue
+        if not tag.isdigit():
+            diags.append(Diagnostic(
+                "FS007", Severity.WARNING, site,
+                f"evolution mapping has a non-numeric epoch tag {tag!r}"))
+            continue
+        epoch = int(tag)
+        if epoch not in epochs:
+            diags.append(Diagnostic(
+                "FS008", Severity.WARNING, site,
+                f"evolution mapping references epoch {epoch} which has no "
+                "checkpoint directory (orphan)",
+                hint="safe to delete after confirming no restore targets it"))
+            continue
+        marker, _prob = _read_marker(storage_url, job_id, epoch, verify=False)
+        meta_hash = (marker or {}).get("plan_hash")
+        old_hash = mapping.get("old_plan_hash")
+        if meta_hash and old_hash and meta_hash != old_hash:
+            diags.append(Diagnostic(
+                "FS007", Severity.ERROR, site,
+                f"evolution mapping pairs old plan {old_hash} but epoch "
+                f"{epoch}'s marker records plan {meta_hash} — the mapping "
+                "was proven for a different plan pair",
+                hint="restore through this mapping would misread state; "
+                     "re-run the evolve API against the actual checkpoint"))
+
+
+def _fsck_orphans(storage_url: str, job_id: str, epochs: list[int],
+                  diags: list[Diagnostic]) -> None:
+    """FS008: files no live metadata references. Table-file orphans are
+    torn-compaction leftovers ``compact_operator`` finishes deleting;
+    spill-run orphans below the newest complete epoch are
+    ``cleanup_spill_runs`` targets. Both are WARNING — owned by GC, not
+    data loss."""
+    known_epoch_files = {"metadata.json", QUARANTINE_MARKER,
+                         QUARANTINED_METADATA}
+    referenced_runs: set[tuple[str, str]] = set()
+    newest_complete = None
+    for epoch in epochs:
+        cdir = checkpoint_dir(storage_url, job_id, epoch)
+        site = f"{job_id}/checkpoints/checkpoint-{epoch:07d}"
+        marker, _prob = _read_marker(storage_url, job_id, epoch, verify=False)
+        if marker is not None:
+            newest_complete = epoch
+        for fn in sorted(storage.listdir(cdir)):
+            d = os.path.join(cdir, fn)
+            if storage.isdir(d):
+                if not fn.startswith("operator-"):
+                    diags.append(Diagnostic(
+                        "FS008", Severity.WARNING, site,
+                        f"unexpected directory {fn!r} in the epoch dir"))
+                    continue
+                sidecar_refs: set[str] = set()
+                for sfn in storage.listdir(d):
+                    if not (sfn.startswith("metadata-")
+                            and sfn.endswith(".json")):
+                        continue
+                    try:
+                        m = json.loads(
+                            storage.read_text(os.path.join(d, sfn)))
+                    except Exception:  # noqa: BLE001 - FS004 reported it
+                        continue
+                    for fm in m.get("files", ()):
+                        sidecar_refs.add(fm.get("file", ""))
+                        for run in fm.get("spill_runs", ()):
+                            referenced_runs.add((fn, run))
+                for sfn in sorted(storage.listdir(d)):
+                    if (sfn.startswith("table-")
+                            and sfn not in sidecar_refs):
+                        diags.append(Diagnostic(
+                            "FS008", Severity.WARNING, site,
+                            f"table file {fn}/{sfn} is referenced by no "
+                            "sidecar (torn-compaction leftover)",
+                            hint="compact_operator finishes the cleanup on "
+                                 "its next pass"))
+            elif fn not in known_epoch_files:
+                diags.append(Diagnostic(
+                    "FS008", Severity.WARNING, site,
+                    f"unexpected file {fn!r} in the epoch dir"))
+    spill_base = os.path.join(storage_url, job_id, "spill")
+    if not storage.isdir(spill_base):
+        return
+    from .spill import _RUN_NAME_RE
+
+    for opd in sorted(storage.listdir(spill_base)):
+        d = os.path.join(spill_base, opd)
+        if not storage.isdir(d):
+            continue
+        for fn in sorted(storage.listdir(d)):
+            m = _RUN_NAME_RE.match(fn)
+            if m is None:
+                continue
+            run_epoch = int(m.group(2))
+            if (newest_complete is not None and run_epoch >= newest_complete):
+                continue  # fresh post-checkpoint run; next manifest owns it
+            if (opd, fn) not in referenced_runs:
+                diags.append(Diagnostic(
+                    "FS008", Severity.WARNING, f"{job_id}/spill/{opd}",
+                    f"spill run {fn} is referenced by no checkpoint "
+                    "manifest (GC target)",
+                    hint="cleanup_spill_runs removes it on the next GC "
+                         "cycle"))
+
+
+def _fsck_spill_footers(storage_url: str, job_id: str,
+                        diags: list[Diagnostic]) -> None:
+    """FS006: every live spill run's self-describing footer must verify
+    (runs outlive epochs, so their integrity rides in the file itself)."""
+    from .spill import _RUN_NAME_RE
+
+    spill_base = os.path.join(storage_url, job_id, "spill")
+    if not storage.isdir(spill_base):
+        return
+    for opd in sorted(storage.listdir(spill_base)):
+        d = os.path.join(spill_base, opd)
+        if not storage.isdir(d):
+            continue
+        for fn in sorted(storage.listdir(d)):
+            if _RUN_NAME_RE.match(fn) is None:
+                continue
+            p = os.path.join(d, fn)
+            try:
+                storage.unwrap_footer(storage.read_bytes(p), p, verify=True)
+            except storage.IntegrityError as e:
+                diags.append(Diagnostic(
+                    "FS006", Severity.ERROR, f"{job_id}/spill/{opd}",
+                    f"spill run {fn} fails its integrity footer: "
+                    f"{e.reason}",
+                    hint="a probe read would fail here; the worker set "
+                         "restores from the checkpoint instead"))
+
+
+def fsck_job(storage_url: str, job_id: str) -> list[Diagnostic]:
+    """Walk one job's whole durable-state chain offline and report every
+    integrity finding as a Diagnostic (FS-series rules; deterministic
+    order via ``finish``). ERROR findings mean a restore would quarantine
+    and fall back; WARNINGs are GC-owned debris or operator-pending
+    quarantines. Checksum verification is ALWAYS on here regardless of
+    ``state.integrity.verify`` — fsck exists to look."""
+    diags: list[Diagnostic] = []
+    epochs = _epoch_tags(storage_url, job_id)
+    if not epochs and not storage.isdir(
+            os.path.join(storage_url, job_id, "checkpoints")):
+        diags.append(Diagnostic(
+            "FS001", Severity.INFO, f"{job_id}/checkpoints",
+            "job has no checkpoints directory (nothing to verify)"))
+        return finish(diags)
+    for epoch in epochs:
+        _fsck_epoch(storage_url, job_id, epoch, diags)
+    _fsck_final(storage_url, job_id, diags)
+    _fsck_evolutions(storage_url, job_id, epochs, diags)
+    _fsck_orphans(storage_url, job_id, epochs, diags)
+    _fsck_spill_footers(storage_url, job_id, diags)
+    return finish(diags)

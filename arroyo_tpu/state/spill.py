@@ -260,7 +260,10 @@ class _AnnexBase:
         path = os.path.join(self.dir, name)
         fault_point(site, key=path, epoch=self.epoch,
                     subtask=self.task_info.subtask_index)
-        write_columnar(path, cols)
+        # runs outlive the epoch whose manifest references them, so they
+        # carry a self-describing integrity footer instead of a manifest
+        # envelope (read_columnar strips + verifies it)
+        write_columnar(path, cols, footer=True)
 
     def _read_run(self, meta: dict) -> dict:
         """Probe-path read: one in-place retry (an injected ``fail_once``

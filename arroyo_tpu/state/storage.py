@@ -33,6 +33,116 @@ _log = logging.getLogger("arroyo_tpu.storage")
 _s3_client = None
 _gcs_client = None
 
+
+class IntegrityError(RuntimeError):
+    """A state artifact's bytes do not match its recorded checksum
+    envelope — truncated upload, bit rot, or a torn write. Restore paths
+    catch this to quarantine the epoch and fall back; it is NOT a
+    transient storage fault and must never be retried."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"integrity check failed for {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+def _crc_impl():
+    """(crc function, algo name): hardware crc32c when a library provides
+    it, else stdlib zlib.crc32. The algo NAME is recorded in every
+    envelope so a reader recomputes with the writer's algorithm."""
+    global _crc_fn, _crc_algo
+    if _crc_fn is None:
+        try:
+            from crc32c import crc32c as _c  # type: ignore
+
+            _crc_fn, _crc_algo = _c, "crc32c"
+        except ImportError:
+            import zlib
+
+            _crc_fn, _crc_algo = zlib.crc32, "crc32"
+    return _crc_fn, _crc_algo
+
+
+_crc_fn = None
+_crc_algo = None
+
+
+def checksum_of(data: bytes) -> dict:
+    """Integrity envelope for one artifact: {crc, len, algo}."""
+    fn, algo = _crc_impl()
+    return {"crc": fn(data) & 0xFFFFFFFF, "len": len(data), "algo": algo}
+
+
+def verify_envelope(data: bytes, env: dict, path: str) -> None:
+    """Raise IntegrityError unless ``data`` matches the recorded envelope.
+    An envelope recorded with an algo this host cannot compute degrades to
+    the length check (logged once per call, never silently)."""
+    want_len = env.get("len")
+    if want_len is not None and len(data) != int(want_len):
+        raise IntegrityError(
+            path, f"length {len(data)} != recorded {want_len}")
+    algo = env.get("algo")
+    fn, have = _crc_impl()
+    if algo not in (None, have):
+        if algo == "crc32":
+            import zlib
+
+            fn = zlib.crc32
+        else:
+            _log.warning("cannot verify %s: recorded algo %r unavailable "
+                         "(length check only)", path, algo)
+            return
+    if "crc" in env and (fn(data) & 0xFFFFFFFF) != int(env["crc"]):
+        raise IntegrityError(
+            path, f"{algo or have} mismatch (recorded {env['crc']})")
+
+
+# Self-describing trailer for artifacts that outlive the epoch whose
+# manifest would otherwise carry their envelope (spill runs): payload +
+# [crc u32][len u64][algo 8s][magic 8s]. The magic sits at the very end so
+# a reader can detect the footer from the tail alone.
+FOOTER_MAGIC = b"ARROYOCK"
+_FOOTER_LEN = 4 + 8 + 8 + 8
+
+
+def wrap_footer(data: bytes) -> bytes:
+    import struct
+
+    env = checksum_of(data)
+    return data + struct.pack(
+        ">IQ", env["crc"], env["len"]) + env["algo"].encode().ljust(8) \
+        + FOOTER_MAGIC
+
+
+def unwrap_footer(data: bytes, path: str = "<buffer>",
+                  verify: bool = True) -> bytes:
+    """Strip (and optionally verify) the integrity footer. Data without a
+    footer passes through untouched — pre-upgrade runs stay readable."""
+    import struct
+
+    if len(data) < _FOOTER_LEN or not data.endswith(FOOTER_MAGIC):
+        return data
+    trailer = data[-_FOOTER_LEN:]
+    crc, length = struct.unpack(">IQ", trailer[:12])
+    algo = trailer[12:20].strip().decode("ascii", "replace")
+    payload = data[:-_FOOTER_LEN]
+    if verify:
+        verify_envelope(payload, {"crc": crc, "len": length, "algo": algo},
+                        path)
+    return payload
+
+
+def _apply_corruption(data: bytes, mode: str) -> bytes:
+    """Deterministic chaos corruption (``storage.*:corrupt=<mode>``):
+    bitflip flips one bit of the middle byte; truncate keeps the first
+    half. Both are detectable by any crc+length envelope."""
+    if not data:
+        return data
+    if mode == "truncate":
+        return data[:len(data) // 2]
+    mid = len(data) // 2
+    return data[:mid] + bytes([data[mid] ^ 0x01]) + data[mid + 1:]
+
 MULTIPART_DEFAULT = 8 * 1024 * 1024
 
 # One breaker across all object-store ops: when the store is hard-down,
@@ -58,6 +168,18 @@ def _guarded(site: str, key: str, fn: Callable):
     def _once():
         fault_point(site, key=key)
         return fn()
+
+    return retry_call(_once, policy=_policy(), retry_on=default_transient,
+                      description=f"{site} {key}", breaker=_breaker)
+
+
+def _guarded_v(site: str, key: str, fn: Callable):
+    """Like _guarded, but the callable receives the fault-point verdict —
+    the data paths (get/put) apply non-raising ``corrupt`` verdicts to the
+    bytes in flight, modeling bit rot / truncated uploads."""
+
+    def _once():
+        return fn(fault_point(site, key=key))
 
     return retry_call(_once, policy=_policy(), retry_on=default_transient,
                       description=f"{site} {key}", breaker=_breaker)
@@ -277,17 +399,22 @@ def _local(path: str) -> str:
 
 
 def read_bytes(path: str) -> bytes:
-    def _do() -> bytes:
+    def _do(verdict) -> bytes:
         s3 = _parse_s3(path)
         if s3:
-            return _get_s3().get_object(Bucket=s3[0], Key=s3[1])["Body"].read()
-        gcs = _parse_gcs(path)
-        if gcs:
-            return _get_gcs().download(gcs[0], gcs[1])
-        with open(_local(path), "rb") as f:
-            return f.read()
+            data = _get_s3().get_object(Bucket=s3[0], Key=s3[1])["Body"].read()
+        else:
+            gcs = _parse_gcs(path)
+            if gcs:
+                data = _get_gcs().download(gcs[0], gcs[1])
+            else:
+                with open(_local(path), "rb") as f:
+                    data = f.read()
+        if verdict and verdict[0] == "corrupt":
+            data = _apply_corruption(data, str(verdict[1]))
+        return data
 
-    return _guarded("storage.get", path, _do)
+    return _guarded_v("storage.get", path, _do)
 
 
 def _multipart_threshold() -> int:
@@ -340,37 +467,57 @@ def _s3_multipart_put(client, bucket: str, key: str, data: bytes,
         raise
 
 
-def write_bytes(path: str, data: bytes) -> None:
-    def _do() -> None:
+def write_bytes(path: str, data: bytes) -> dict:
+    """Write one artifact and return its integrity envelope {crc, len,
+    algo}, computed on the TRUE bytes BEFORE any injected corruption — a
+    corrupt-on-put chaos fault is therefore detectable on read, exactly
+    like a real truncated upload."""
+    env = checksum_of(data)
+
+    def _do(verdict) -> None:
+        payload = data
+        if verdict and verdict[0] == "corrupt":
+            payload = _apply_corruption(payload, str(verdict[1]))
         s3 = _parse_s3(path)
         if s3:
             client = _get_s3()
             threshold = _multipart_threshold()
-            if (len(data) > threshold
+            if (len(payload) > threshold
                     and hasattr(client, "create_multipart_upload")):
-                _s3_multipart_put(client, s3[0], s3[1], data, _multipart_part_size())
+                _s3_multipart_put(client, s3[0], s3[1], payload,
+                                  _multipart_part_size())
             else:
-                client.put_object(Bucket=s3[0], Key=s3[1], Body=data)
+                client.put_object(Bucket=s3[0], Key=s3[1], Body=payload)
             return
         gcs = _parse_gcs(path)
         if gcs:
-            _get_gcs().upload(gcs[0], gcs[1], data)
+            _get_gcs().upload(gcs[0], gcs[1], payload)
             return
         p = _local(path)
         tmp = p + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(data)
+            f.write(payload)
         os.replace(tmp, p)
 
-    _guarded("storage.put", path, _do)
+    _guarded_v("storage.put", path, _do)
+    return env
 
 
 def read_text(path: str) -> str:
     return read_bytes(path).decode("utf-8")
 
 
-def write_text(path: str, text: str) -> None:
-    write_bytes(path, text.encode("utf-8"))
+def write_text(path: str, text: str) -> dict:
+    return write_bytes(path, text.encode("utf-8"))
+
+
+def verify_mode() -> str:
+    """``state.integrity.verify``: ``restore`` (default — verify artifacts
+    on the restore path only), ``always`` (every checkpointed read), or
+    ``off`` (trust the store; fsck still verifies explicitly)."""
+    from ..config import config
+
+    return str(config().get("state.integrity.verify") or "restore")
 
 
 # -------------------------------------------------------------- directory

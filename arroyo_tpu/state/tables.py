@@ -46,6 +46,55 @@ from . import storage
 _log = logging.getLogger("arroyo_tpu.state")
 
 
+class RestoreError(RuntimeError):
+    """A restore-path read failed, with enough context to say exactly what
+    was skipped: the epoch, the operator, the artifact path, and the
+    underlying cause (an IntegrityError, a codec error, a missing file).
+    The fallback ladder and the event feed both render from this."""
+
+    def __init__(self, epoch, operator: str, path: str, cause: Exception):
+        super().__init__(
+            f"restore of operator {operator!r} from epoch {epoch} failed "
+            f"at {path}: {cause}")
+        self.epoch = epoch
+        self.operator = operator
+        self.path = path
+        self.cause = cause
+
+
+def _should_verify(restore: bool = False) -> bool:
+    """Whether this read verifies its integrity envelope, per
+    ``state.integrity.verify``: off = never, always = every read,
+    restore (default) = restore-path reads only."""
+    mode = storage.verify_mode()
+    if mode == "off":
+        return False
+    if mode == "always":
+        return True
+    return restore
+
+
+def dump_json_with_integrity(obj: dict) -> str:
+    """Serialize a JSON artifact with an embedded ``__integrity__``
+    envelope over its canonical (sorted-keys) form, so the artifact
+    self-verifies without a sidecar."""
+    body = json.dumps(obj, sort_keys=True)
+    env = storage.checksum_of(body.encode("utf-8"))
+    return json.dumps({**obj, "__integrity__": env})
+
+
+def load_json_with_integrity(text: str, path: str, verify: bool) -> dict:
+    """Parse a JSON artifact, verifying the embedded envelope when asked.
+    Artifacts written before the envelope existed carry no key and pass
+    through. Raises storage.IntegrityError on mismatch."""
+    obj = json.loads(text)
+    env = obj.pop("__integrity__", None)
+    if env is not None and verify:
+        storage.verify_envelope(
+            json.dumps(obj, sort_keys=True).encode("utf-8"), env, path)
+    return obj
+
+
 def _parquet_available() -> bool:
     try:
         import pyarrow  # noqa: F401
@@ -72,10 +121,15 @@ def _format_of(path: str) -> str:
     return "npz" if path.endswith(".npz") else "parquet"
 
 
-def write_columnar(path: str, columns: dict) -> None:
+def write_columnar(path: str, columns: dict, footer: bool = False) -> dict:
     """Write named columns to ``path`` in the configured codec. Object
     columns keep their python value types: pyarrow type inference for
-    parquet (nullable ints stay ints), a pickled sidecar for npz."""
+    parquet (nullable ints stay ints), a pickled sidecar for npz.
+
+    Returns the integrity envelope {crc, len, algo} of the written bytes
+    for the caller's manifest. ``footer=True`` instead appends the
+    self-describing integrity trailer (storage.wrap_footer) — for
+    artifacts like spill runs that outlive any one epoch's manifest."""
     if _checkpoint_format() == "parquet":
         import pyarrow as pa
         import pyarrow.parquet as pq
@@ -101,8 +155,10 @@ def write_columnar(path: str, columns: dict) -> None:
         buf = io.BytesIO()
         with _PARQUET_IO_LOCK:
             pq.write_table(pa.table(arrays, names=names), buf)
-        storage.write_bytes(path, buf.getvalue())
-        return
+        payload = buf.getvalue()
+        if footer:
+            payload = storage.wrap_footer(payload)
+        return storage.write_bytes(path, payload)
     dense = {}
     objcols: dict[str, list] = {}
     for name, col in columns.items():
@@ -116,16 +172,29 @@ def write_columnar(path: str, columns: dict) -> None:
         dense["__objcols__"] = np.frombuffer(pickle.dumps(objcols), dtype=np.uint8)
     buf = io.BytesIO()
     np.savez(buf, **dense)
-    storage.write_bytes(path, buf.getvalue())
+    payload = buf.getvalue()
+    if footer:
+        payload = storage.wrap_footer(payload)
+    return storage.write_bytes(path, payload)
 
 
-def read_columnar(path: str) -> dict:
+def read_columnar(path: str, expect: Optional[dict] = None,
+                  restore: bool = False) -> dict:
+    """Read a columnar state file. ``expect`` is the integrity envelope a
+    manifest recorded for this file; a self-describing footer (spill runs)
+    is stripped unconditionally so the codecs never see it. Verification
+    of either form is gated by ``state.integrity.verify`` (``restore``
+    marks this read as a restore-path read)."""
+    verify = _should_verify(restore)
+    data = storage.read_bytes(path)
+    if expect is not None and verify and "crc" in expect:
+        storage.verify_envelope(data, expect, path)
+    data = storage.unwrap_footer(data, path, verify=verify)
     if _format_of(path) == "parquet":
         import pyarrow.parquet as pq
 
-        # fetch before taking the parquet lock (LR105): the storage read can
-        # block on the network and must not serialize other readers
-        data = storage.read_bytes(path)
+        # bytes fetched before taking the parquet lock (LR105): the storage
+        # read can block on the network and must not serialize other readers
         with _PARQUET_IO_LOCK:
             table = pq.read_table(io.BytesIO(data), use_threads=False)
         cols: dict[str, np.ndarray] = {}
@@ -146,12 +215,12 @@ def read_columnar(path: str) -> dict:
             else:
                 cols[name] = np.asarray(arr.to_numpy(zero_copy_only=False))
         return cols
-    data = np.load(io.BytesIO(storage.read_bytes(path)), allow_pickle=False)
-    cols = {name: data[name] for name in data.files if name != "__objcols__"}
-    if "__objcols__" in data.files:
+    npz = np.load(io.BytesIO(data), allow_pickle=False)
+    cols = {name: npz[name] for name in npz.files if name != "__objcols__"}
+    if "__objcols__" in npz.files:
         from ..batch import object_column
 
-        objcols = pickle.loads(data["__objcols__"].tobytes())
+        objcols = pickle.loads(npz["__objcols__"].tobytes())
         for name, vals in objcols.items():
             cols[name] = object_column(vals)
     return cols
@@ -190,12 +259,18 @@ class GlobalKeyedTable:
     # -- checkpoint ---------------------------------------------------------
 
     def write_checkpoint(self, path: str) -> dict:
-        storage.write_bytes(path, pickle.dumps(self.data))
-        return {"file": os.path.basename(path), "kind": "global_keyed"}
+        env = storage.write_bytes(path, pickle.dumps(self.data))
+        return {"file": os.path.basename(path), "kind": "global_keyed", **env}
 
-    def load_files(self, paths: Iterable[str]) -> None:
-        for p in paths:
-            self.data.update(pickle.loads(storage.read_bytes(p)))
+    def load_files(self, entries: Iterable) -> None:
+        """Entries are paths, or (path, file-meta) pairs whose meta may
+        carry the integrity envelope recorded at checkpoint time."""
+        for e in entries:
+            p, fm = e if isinstance(e, tuple) else (e, None)
+            data = storage.read_bytes(p)
+            if fm is not None and "crc" in fm and _should_verify(True):
+                storage.verify_envelope(data, fm, p)
+            self.data.update(pickle.loads(data))
 
 
 class ExpiringTimeKeyTable:
@@ -240,13 +315,14 @@ class ExpiringTimeKeyTable:
         if not self.batches:
             return None
         merged = Batch.concat(self.batches)
-        write_columnar(path, merged.columns)
+        env = write_columnar(path, merged.columns)
         ts = merged.timestamps
         meta = {
             "file": os.path.basename(path),
             "kind": "expiring_time_key",
             "min_timestamp": int(ts.min()),
             "max_timestamp": int(ts.max()),
+            **env,
         }
         if KEY_FIELD in merged:
             k = merged.keys
@@ -270,7 +346,7 @@ class ExpiringTimeKeyTable:
                 continue
             if "min_key" in meta and (meta["min_key"] > hi or meta["max_key"] < lo):
                 continue
-            cols = read_columnar(path)
+            cols = read_columnar(path, expect=meta, restore=True)
             batch = Batch(cols)
             if KEY_FIELD in batch:
                 keys = batch.keys
@@ -341,8 +417,12 @@ class TableManager:
             "watermark_micros": watermark_micros,
             "files": files,
         }
-        storage.write_text(os.path.join(opdir, f"metadata-{sub}.json"), json.dumps(meta))
-        return meta
+        # self-checksummed sidecar; the envelope of the WRITTEN bytes rides
+        # back in the (unwritten) "sidecar" entry so the job-level marker's
+        # integrity manifest can cover the sidecar file itself
+        env = storage.write_text(os.path.join(opdir, f"metadata-{sub}.json"),
+                                 dump_json_with_integrity(meta))
+        return {**meta, "sidecar": {"file": f"metadata-{sub}.json", **env}}
 
     def restore(self, epoch: int, table_specs: list,
                 mapping: Optional[dict] = None) -> Optional[int]:
@@ -387,7 +467,12 @@ class TableManager:
                 return out
             for fn in storage.listdir(d):
                 if fn.startswith("metadata-") and fn.endswith(".json"):
-                    m = json.loads(storage.read_text(os.path.join(d, fn)))
+                    p = os.path.join(d, fn)
+                    try:
+                        m = load_json_with_integrity(
+                            storage.read_text(p), p, _should_verify(True))
+                    except Exception as e:  # noqa: BLE001 - context for the ladder
+                        raise RestoreError(epoch, ti.node_id, p, e) from e
                     m["__dir__"] = d
                     out.append(m)
             return out
@@ -451,13 +536,20 @@ class TableManager:
                     tname, ti.node_id, len(entries))
                 continue
             kind = entries[0][1].get("kind")
-            if kind == "global_keyed":
-                self.global_keyed(tname).load_files(p for p, _ in entries)
-            else:
-                retention = spec.retention_micros if spec else entries[0][1].get("retention_micros", 0)
-                self.expiring_time_key(tname, retention).load_files(
-                    entries, ti.key_range, restored_wm
-                )
+            try:
+                if kind == "global_keyed":
+                    self.global_keyed(tname).load_files(entries)
+                else:
+                    retention = spec.retention_micros if spec else entries[0][1].get("retention_micros", 0)
+                    self.expiring_time_key(tname, retention).load_files(
+                        entries, ti.key_range, restored_wm
+                    )
+            except RestoreError:
+                raise
+            except Exception as e:  # noqa: BLE001 - context for the ladder
+                raise RestoreError(
+                    epoch, ti.node_id, getattr(e, "path", entries[0][0]),
+                    e) from e
         return restored_wm
 
 
@@ -486,7 +578,9 @@ def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
     metas = []
     for fn in storage.listdir(opdir):
         if fn.startswith("metadata-") and fn.endswith(".json"):
-            metas.append((fn, json.loads(storage.read_text(os.path.join(opdir, fn)))))
+            p = os.path.join(opdir, fn)
+            metas.append((fn, load_json_with_integrity(
+                storage.read_text(p), p, _should_verify())))
     if not metas:
         return 0
     removed = 0
@@ -513,7 +607,8 @@ def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
                  if fm["table"] in done_tables and int(fm.get("generation", 0)) == 0]
         if stale:
             m["files"] = [fm for fm in m["files"] if fm not in stale]
-            storage.write_text(os.path.join(opdir, fn), json.dumps(m))
+            storage.write_text(os.path.join(opdir, fn),
+                               dump_json_with_integrity(m))
             for fm in stale:
                 try:
                     storage.remove(os.path.join(opdir, fm["file"]))
@@ -538,19 +633,20 @@ def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
             data: dict = {}
             for fm in fmetas:
                 data.update(pickle.loads(storage.read_bytes(os.path.join(opdir, fm["file"]))))
-            storage.write_bytes(out_path, pickle.dumps(data))
-            merged = dict(fmetas[0])
+            env = storage.write_bytes(out_path, pickle.dumps(data))
+            merged = {**fmetas[0], **env}
             if any("spill_runs" in fm for fm in fmetas):
                 # a merged __spill manifest table still references every
                 # subtask's runs — the GC liveness union must not shrink
                 merged["spill_runs"] = sorted(
                     {rf for fm in fmetas for rf in fm.get("spill_runs", ())})
         else:
-            col_parts = [read_columnar(os.path.join(opdir, fm["file"])) for fm in fmetas]
+            col_parts = [read_columnar(os.path.join(opdir, fm["file"]),
+                                       expect=fm) for fm in fmetas]
             names = col_parts[0].keys()
             cols = {n: np.concatenate([p[n] for p in col_parts]) for n in names}
-            write_columnar(out_path, cols)
-            merged = dict(fmetas[0])
+            env = write_columnar(out_path, cols)
+            merged = {**fmetas[0], **env}
             merged["min_timestamp"] = min(fm["min_timestamp"] for fm in fmetas)
             merged["max_timestamp"] = max(fm["max_timestamp"] for fm in fmetas)
             if all("min_key" in fm for fm in fmetas):
@@ -580,7 +676,8 @@ def compact_operator(storage_url: str, job_id: str, epoch, node_id: str) -> int:
         if m["subtask_index"] == holder:
             kept.extend(merged_files.values())
         m["files"] = kept
-        storage.write_text(os.path.join(opdir, fn), json.dumps(m))
+        storage.write_text(os.path.join(opdir, fn),
+                           dump_json_with_integrity(m))
     for fmetas in by_table.values():
         if len(fmetas) < 2:
             continue
@@ -605,10 +702,58 @@ def compact_job(storage_url: str, job_id: str, epoch) -> int:
     return total
 
 
+QUARANTINE_MARKER = "quarantine.json"
+QUARANTINED_METADATA = "metadata.json.quarantined"
+
+
+def is_quarantined(storage_url: str, job_id: str, epoch: int) -> bool:
+    """True when an operator must resolve this epoch before anything may
+    touch it: restore skips it, GC refuses it, subsume refuses it."""
+    d = checkpoint_dir(storage_url, job_id, epoch)
+    return (storage.exists(os.path.join(d, QUARANTINE_MARKER))
+            or storage.exists(os.path.join(d, QUARANTINED_METADATA)))
+
+
+def quarantine_epoch(storage_url: str, job_id: str, epoch: int,
+                     reason: str) -> None:
+    """Take a corrupt/incomplete epoch out of the restore chain WITHOUT
+    deleting anything: the commit marker is preserved byte-exactly under
+    ``metadata.json.quarantined`` (forensics + operator resolution), a
+    ``quarantine.json`` records why, and only then is ``metadata.json``
+    removed so selection skips the epoch. Crash-safe in that order: a
+    crash mid-quarantine leaves both markers present — the epoch is
+    already quarantined (is_quarantined) and still complete-looking, and
+    the next restore attempt re-converges by re-running this function
+    (idempotent)."""
+    d = checkpoint_dir(storage_url, job_id, epoch)
+    marker = os.path.join(d, "metadata.json")
+    storage.makedirs(d)
+    if storage.exists(marker):
+        try:
+            storage.write_bytes(os.path.join(d, QUARANTINED_METADATA),
+                                storage.read_bytes(marker))
+        except Exception as e:  # noqa: BLE001 - marker itself unreadable
+            _log.warning("quarantine epoch %s: could not preserve marker "
+                         "bytes: %s", epoch, e)
+    storage.write_text(
+        os.path.join(d, QUARANTINE_MARKER),
+        dump_json_with_integrity({"job_id": job_id, "epoch": epoch,
+                                  "reason": reason}))
+    if storage.exists(marker):
+        try:
+            storage.remove(marker)
+        except FileNotFoundError:
+            pass
+    _log.warning("checkpoint epoch %s of job %s QUARANTINED: %s",
+                 epoch, job_id, reason)
+
+
 def cleanup_checkpoints(storage_url: str, job_id: str, min_epoch: int) -> int:
     """Delete checkpoints below ``min_epoch`` (reference
     parquet.rs:214 cleanup_operator + controller epoch GC). The "final"
-    drained-source snapshot is always kept. Returns dirs removed."""
+    drained-source snapshot is always kept, and so is every QUARANTINED
+    epoch — evidence of corruption awaits an operator, GC never destroys
+    it. Returns dirs removed."""
     base = os.path.join(storage_url, job_id, "checkpoints")
     if not storage.isdir(base):
         return 0
@@ -620,6 +765,8 @@ def cleanup_checkpoints(storage_url: str, job_id: str, min_epoch: int) -> int:
         if not tag.isdigit():
             continue  # "final" and friends
         if int(tag) < min_epoch:
+            if is_quarantined(storage_url, job_id, int(tag)):
+                continue
             storage.rmtree(os.path.join(base, fn))
             removed += 1
     return removed
@@ -632,9 +779,13 @@ def subsume_torn_epoch(storage_url: str, job_id: str, epoch: int) -> bool:
     suite proves for compaction — an epoch directory WITHOUT its job-level
     metadata marker is invisible to restore, so deleting it cannot lose
     state. Refuses to touch a complete epoch (marker present): those are
-    restore targets and only epoch GC may drop them."""
+    restore targets and only epoch GC may drop them. Also refuses a
+    QUARANTINED epoch — its marker was deliberately renamed away, but the
+    directory is operator-owned evidence, not torn garbage."""
     d = checkpoint_dir(storage_url, job_id, epoch)
     if storage.exists(os.path.join(d, "metadata.json")):
+        return False
+    if is_quarantined(storage_url, job_id, epoch):
         return False
     if not storage.isdir(d):
         return False
@@ -654,8 +805,10 @@ def write_job_checkpoint_metadata(
     if extra:
         payload.update(extra)
     # atomic publish: the marker's existence declares the epoch complete;
-    # storage.write_text lands via tmp+rename locally / atomic PUT on S3
-    storage.write_text(path, json.dumps(payload))
+    # storage.write_text lands via tmp+rename locally / atomic PUT on S3.
+    # The marker self-checksums (__integrity__) so a torn/corrupted write
+    # is detectable, not just unparseable.
+    storage.write_text(path, dump_json_with_integrity(payload))
     return path
 
 
@@ -664,10 +817,12 @@ def read_job_checkpoint_metadata(storage_url: str, job_id: str, epoch: int) -> O
     if not storage.exists(path):
         return None
     try:
-        return json.loads(storage.read_text(path))
-    except (json.JSONDecodeError, OSError):
-        # pre-atomic-write torn file: treat as metadata-less (restore
-        # validation is skipped, matching pre-validation behavior)
+        return load_json_with_integrity(storage.read_text(path), path,
+                                        _should_verify(True))
+    except (json.JSONDecodeError, OSError, storage.IntegrityError):
+        # torn or corrupted marker: treat as absent — the SAME predicate
+        # latest_complete_checkpoint selects on, so a torn marker can never
+        # be "complete" for selection yet metadata-less for restore
         return None
 
 
@@ -687,7 +842,7 @@ def write_evolution_mapping(
     complete one, never a torn half."""
     path = evolution_mapping_path(storage_url, job_id, epoch)
     storage.makedirs(os.path.dirname(path))
-    storage.write_text(path, json.dumps(mapping))
+    storage.write_text(path, dump_json_with_integrity(mapping))
     return path
 
 
@@ -698,17 +853,27 @@ def read_evolution_mapping(
     if not storage.exists(path):
         return None
     try:
-        return json.loads(storage.read_text(path))
-    except (json.JSONDecodeError, OSError):
+        return load_json_with_integrity(storage.read_text(path), path,
+                                        _should_verify(True))
+    except (json.JSONDecodeError, OSError, storage.IntegrityError):
         return None
 
 
 def latest_complete_checkpoint(storage_url: str, job_id: str) -> Optional[int]:
+    """Newest epoch whose job-level marker PARSES (and, when verification
+    is on, checksums) — the same predicate restore reads it with, so
+    selection and restore can never disagree about a torn marker."""
     base = os.path.join(storage_url, job_id, "checkpoints")
     if not storage.isdir(base):
         return None
     epochs = []
     for fn in storage.listdir(base):
-        if fn.startswith("checkpoint-") and storage.exists(os.path.join(base, fn, "metadata.json")):
-            epochs.append(int(fn.split("-")[1]))
+        if not fn.startswith("checkpoint-"):
+            continue
+        tag = fn.split("-", 1)[1]
+        if not tag.isdigit():
+            continue
+        e = int(tag)
+        if read_job_checkpoint_metadata(storage_url, job_id, e) is not None:
+            epochs.append(e)
     return max(epochs) if epochs else None

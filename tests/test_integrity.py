@@ -1,0 +1,494 @@
+"""Checkpoint integrity & disaster recovery (state/integrity.py): the
+checksummed-envelope write side, the quarantine-and-fall-back restore
+ladder, the offline fsck walker, the `corrupt` chaos action, and the
+unified bad_data drop policy — one test per corruption class (truncated
+table file, bit-flipped sidecar, missing spill run, torn marker) asserting
+detection, quarantine, and byte-exact fallback."""
+
+import json
+import os
+
+import pytest
+
+from arroyo_tpu import faults
+from arroyo_tpu.batch import TIMESTAMP_FIELD, Schema
+from arroyo_tpu.engine import Engine
+from arroyo_tpu.graph import EdgeType, Graph, Node, OpName
+from arroyo_tpu.state import storage
+from arroyo_tpu.state.integrity import (
+    fold_integrity,
+    fsck_job,
+    latest_valid_checkpoint,
+    verify_epoch,
+)
+from arroyo_tpu.state.tables import (
+    QUARANTINE_MARKER,
+    QUARANTINED_METADATA,
+    RestoreError,
+    TableManager,
+    checkpoint_dir,
+    cleanup_checkpoints,
+    dump_json_with_integrity,
+    is_quarantined,
+    latest_complete_checkpoint,
+    quarantine_epoch,
+    read_job_checkpoint_metadata,
+    write_job_checkpoint_metadata,
+)
+from arroyo_tpu.operators.base import TableSpec
+from arroyo_tpu.types import TaskInfo
+
+DUMMY = Schema.of([("x", "int64"), (TIMESTAMP_FIELD, "int64")])
+
+
+def _build(rows, count=5000):
+    g = Graph()
+    g.add_node(Node("src", OpName.SOURCE,
+                    {"connector": "impulse", "message_count": count,
+                     "event_rate": 5000}, 1))
+    g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": rows}, 1))
+    g.add_edge("src", "sink", EdgeType.FORWARD, DUMMY)
+    return g
+
+
+def _run_epochs(job_id, n_epochs=2):
+    """Run an impulse->vec pipeline, checkpoint ``n_epochs`` times mid-
+    stream, then stop without finishing (state survives on disk)."""
+    rows: list = []
+    eng = Engine(_build(rows), job_id=job_id)
+    eng.start()
+    for e in range(1, n_epochs + 1):
+        assert eng.checkpoint_and_wait(e, timeout=30)
+    eng.stop()
+    eng.join(timeout=30)
+    return rows
+
+
+def _table_files(storage_url, job_id, epoch):
+    """Every (path, name) table file under one epoch's operator dirs."""
+    out = []
+    d = checkpoint_dir(storage_url, job_id, epoch)
+    for opd in sorted(os.listdir(d)):
+        p = os.path.join(d, opd)
+        if not (opd.startswith("operator-") and os.path.isdir(p)):
+            continue
+        for fn in sorted(os.listdir(p)):
+            if fn.startswith("table-"):
+                out.append((os.path.join(p, fn), f"{opd}/{fn}"))
+    return out
+
+
+def _sidecars(storage_url, job_id, epoch):
+    out = []
+    d = checkpoint_dir(storage_url, job_id, epoch)
+    for opd in sorted(os.listdir(d)):
+        p = os.path.join(d, opd)
+        if not (opd.startswith("operator-") and os.path.isdir(p)):
+            continue
+        for fn in sorted(os.listdir(p)):
+            if fn.startswith("metadata-") and fn.endswith(".json"):
+                out.append(os.path.join(p, fn))
+    return out
+
+
+def _bitflip(path):
+    with open(path, "rb") as f:
+        data = f.read()
+    mid = len(data) // 2
+    with open(path, "wb") as f:
+        f.write(data[:mid] + bytes([data[mid] ^ 0x01]) + data[mid + 1:])
+
+
+def _errors(diags):
+    from arroyo_tpu.analysis import Severity
+
+    return [d for d in diags if d.severity == Severity.ERROR]
+
+
+# ---------------------------------------------------------------- write side
+
+
+def test_marker_carries_integrity_manifest(_storage):
+    _run_epochs("intg-manifest", n_epochs=1)
+    marker = read_job_checkpoint_metadata(_storage, "intg-manifest", 1)
+    manifest = marker.get("integrity")
+    assert manifest, "job-level marker must fold the per-epoch manifest"
+    for rel, env in manifest.items():
+        assert rel.startswith("operator-")
+        assert set(env) >= {"crc", "len", "algo"}
+    # every manifest entry names a real artifact whose bytes verify
+    cdir = checkpoint_dir(_storage, "intg-manifest", 1)
+    for rel, env in manifest.items():
+        data = storage.read_bytes(os.path.join(cdir, rel))
+        storage.verify_envelope(data, env, rel)
+
+
+def test_fold_integrity_shapes():
+    metas = [{"node_id": "src",
+              "files": [{"file": "table-s-000.bin", "table": "s",
+                         "crc": 7, "len": 3, "algo": "crc32"},
+                        {"file": "legacy.bin", "table": "l"}],  # no envelope
+              "sidecar": {"file": "metadata-000.json", "crc": 9, "len": 2,
+                          "algo": "crc32"}},
+             {"no_node": True}, None]
+    m = fold_integrity(x for x in metas if x)
+    assert m == {
+        "operator-src/table-s-000.bin": {"crc": 7, "len": 3, "algo": "crc32"},
+        "operator-src/metadata-000.json": {"crc": 9, "len": 2,
+                                           "algo": "crc32"}}
+
+
+def test_healthy_job_fsck_clean_and_cli_exit_zero(_storage, capsys):
+    _run_epochs("intg-clean", n_epochs=2)
+    diags = fsck_job(_storage, "intg-clean")
+    assert not _errors(diags), [d.render() for d in diags]
+
+    from arroyo_tpu.cli import main
+
+    rc = main(["fsck", "intg-clean", "--storage-url", _storage])
+    assert rc == 0
+    assert "fsck" in capsys.readouterr().out
+
+
+# ------------------------------------------------- corruption class: table
+
+
+def test_truncated_table_file_quarantines_and_falls_back(_storage):
+    _run_epochs("intg-trunc", n_epochs=2)
+    path, rel = _table_files(_storage, "intg-trunc", 2)[0]
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(data[: len(data) // 2])
+
+    # fsck detects the corruption as an FS005 ERROR before any restore runs
+    diags = fsck_job(_storage, "intg-trunc")
+    assert any(d.rule_id == "FS005" for d in _errors(diags))
+
+    # the ladder quarantines epoch 2 and falls back to epoch 1
+    quarantined = []
+    epoch, skipped = latest_valid_checkpoint(
+        _storage, "intg-trunc",
+        on_quarantine=lambda e, r: quarantined.append((e, r)))
+    assert epoch == 1
+    assert [s["epoch"] for s in skipped] == [2]
+    assert quarantined and quarantined[0][0] == 2
+    assert is_quarantined(_storage, "intg-trunc", 2)
+    d2 = checkpoint_dir(_storage, "intg-trunc", 2)
+    # the marker was preserved, never deleted
+    assert os.path.exists(os.path.join(d2, QUARANTINED_METADATA))
+    assert os.path.exists(os.path.join(d2, QUARANTINE_MARKER))
+    assert not os.path.exists(os.path.join(d2, "metadata.json"))
+    # after quarantine the epoch downgrades to an FS003 warning, not ERROR
+    diags2 = fsck_job(_storage, "intg-trunc")
+    assert not _errors(diags2)
+    assert any(d.rule_id == "FS003" for d in diags2)
+
+    # restoring from the fallback epoch replays the gap byte-exactly
+    rows: list = []
+    eng = Engine(_build(rows), job_id="intg-trunc", restore_epoch=epoch)
+    eng.run_to_completion(timeout=60)
+    counters = sorted(r["counter"] for r in rows)
+    assert counters == list(range(counters[0], 5000))
+
+
+# ----------------------------------------------- corruption class: sidecar
+
+
+def test_bitflipped_sidecar_quarantines_and_falls_back(_storage):
+    _run_epochs("intg-flip", n_epochs=2)
+    _bitflip(_sidecars(_storage, "intg-flip", 2)[0])
+
+    diags = fsck_job(_storage, "intg-flip")
+    assert any(d.rule_id == "FS004" for d in _errors(diags))
+
+    epoch, skipped = latest_valid_checkpoint(_storage, "intg-flip")
+    assert epoch == 1
+    assert [s["epoch"] for s in skipped] == [2]
+    assert is_quarantined(_storage, "intg-flip", 2)
+
+
+# --------------------------------------------- corruption class: spill run
+
+
+def test_missing_spill_run_detected(_storage):
+    """A sidecar referencing a spill run that is gone must fail the epoch
+    (synthesized layout: spill runs outlive epochs, so liveness is part of
+    epoch validity)."""
+    job = "intg-spill"
+    opdir = os.path.join(checkpoint_dir(_storage, job, 1), "operator-agg")
+    storage.makedirs(opdir)
+    table_bytes = b"columnar-bytes"
+    env = storage.write_bytes(os.path.join(opdir, "table-t-000.bin"),
+                              table_bytes)
+    run = "run-aa-s0-e1-0.parquet"
+    sidecar = {"node_id": "agg", "subtask_index": 0,
+               "files": [{"file": "table-t-000.bin", "table": "t", **env,
+                          "spill_runs": [run]}]}
+    storage.write_text(os.path.join(opdir, "metadata-000.json"),
+                       dump_json_with_integrity(sidecar))
+    write_job_checkpoint_metadata(
+        _storage, job, 1,
+        {"operators": ["agg"], "integrity": fold_integrity([{
+            "node_id": "agg", "files": sidecar["files"]}])})
+
+    problems = verify_epoch(_storage, job, 1)
+    assert any("spill run" in p for p in problems)
+    diags = fsck_job(_storage, job)
+    assert any(d.rule_id == "FS006" for d in _errors(diags))
+    epoch, skipped = latest_valid_checkpoint(_storage, job)
+    assert epoch is None and [s["epoch"] for s in skipped] == [1]
+
+    # restore the run (footer-wrapped, as the spill writer produces) on a
+    # fresh copy of the job: the epoch verifies again
+    job2 = "intg-spill-ok"
+    opdir2 = os.path.join(checkpoint_dir(_storage, job2, 1), "operator-agg")
+    storage.makedirs(opdir2)
+    storage.write_bytes(os.path.join(opdir2, "table-t-000.bin"), table_bytes)
+    storage.write_text(os.path.join(opdir2, "metadata-000.json"),
+                       dump_json_with_integrity(sidecar))
+    write_job_checkpoint_metadata(_storage, job2, 1, {"operators": ["agg"]})
+    rd = os.path.join(_storage, job2, "spill", "operator-agg")
+    storage.makedirs(rd)
+    with open(os.path.join(rd, run), "wb") as f:
+        f.write(storage.wrap_footer(b"parquet-bytes"))
+    assert verify_epoch(_storage, job2, 1) == []
+    assert not _errors(fsck_job(_storage, job2))
+
+
+def test_corrupt_spill_footer_is_fsck_error(_storage):
+    job = "intg-footer"
+    storage.makedirs(os.path.join(checkpoint_dir(_storage, job, 1)))
+    write_job_checkpoint_metadata(_storage, job, 1, {"operators": []})
+    rd = os.path.join(_storage, job, "spill", "operator-agg")
+    storage.makedirs(rd)
+    p = os.path.join(rd, "run-bb-s0-e1-0.parquet")
+    with open(p, "wb") as f:
+        f.write(storage.wrap_footer(b"payload-bytes"))
+    _bitflip(p)
+    diags = fsck_job(_storage, job)
+    assert any(d.rule_id == "FS006" for d in _errors(diags))
+
+
+# ------------------------------------------------ corruption class: marker
+
+
+def test_torn_marker_unified_predicate_and_fallback(_storage):
+    _run_epochs("intg-torn", n_epochs=2)
+    marker = os.path.join(checkpoint_dir(_storage, "intg-torn", 2),
+                          "metadata.json")
+    with open(marker, "w") as f:
+        f.write('{"job_id": "intg-torn", "epo')  # torn mid-write
+
+    # selection and restore share ONE torn-marker predicate: both treat
+    # the epoch as absent, never "complete for selection, torn for restore"
+    assert read_job_checkpoint_metadata(_storage, "intg-torn", 2) is None
+    assert latest_complete_checkpoint(_storage, "intg-torn") == 1
+
+    diags = fsck_job(_storage, "intg-torn")
+    assert any(d.rule_id == "FS002" for d in _errors(diags))
+
+    epoch, skipped = latest_valid_checkpoint(_storage, "intg-torn")
+    assert epoch == 1
+    assert [s["epoch"] for s in skipped] == [2]
+    assert is_quarantined(_storage, "intg-torn", 2)
+
+
+def test_markerless_epoch_is_invisible_not_quarantined(_storage):
+    """A directory with NO marker at all is a torn checkpoint the watchdog
+    subsumes — the ladder skips it silently rather than quarantining."""
+    _run_epochs("intg-nomark", n_epochs=2)
+    os.remove(os.path.join(checkpoint_dir(_storage, "intg-nomark", 2),
+                           "metadata.json"))
+    epoch, skipped = latest_valid_checkpoint(_storage, "intg-nomark")
+    assert epoch == 1 and skipped == []
+    assert not is_quarantined(_storage, "intg-nomark", 2)
+    diags = fsck_job(_storage, "intg-nomark")
+    assert not _errors(diags)
+    assert any(d.rule_id == "FS001" for d in diags)
+
+
+# ------------------------------------------------------------- GC refusal
+
+
+def test_gc_never_collects_a_quarantined_epoch(_storage):
+    _run_epochs("intg-gc", n_epochs=2)
+    quarantine_epoch(_storage, "intg-gc", 1, "test corruption evidence")
+    removed = cleanup_checkpoints(_storage, "intg-gc", min_epoch=99)
+    assert removed >= 1  # epoch 2 was collectable
+    assert os.path.isdir(checkpoint_dir(_storage, "intg-gc", 1))
+    assert not os.path.isdir(checkpoint_dir(_storage, "intg-gc", 2))
+    assert is_quarantined(_storage, "intg-gc", 1)
+
+
+# ------------------------------------------------------ corrupt chaos action
+
+
+def test_corrupt_fault_action_write_side(_storage):
+    """storage.put:corrupt=bitflip persists corrupted bytes while the
+    envelope records the intended ones — exactly what the manifest is for:
+    fsck flags it and the ladder refuses the epoch."""
+    faults.install("storage.put:corrupt=bitflip@match=table-")
+    _run_epochs("intg-chaos", n_epochs=1)
+    faults.clear()
+    diags = fsck_job(_storage, "intg-chaos")
+    assert any(d.rule_id == "FS005" for d in _errors(diags))
+    epoch, skipped = latest_valid_checkpoint(_storage, "intg-chaos")
+    assert epoch is None
+    assert [s["epoch"] for s in skipped] == [1]
+    assert is_quarantined(_storage, "intg-chaos", 1)
+
+
+def test_corrupt_fault_action_parses_and_rejects_bad_mode():
+    from arroyo_tpu.faults.plan import PlanSyntaxError, parse_plan
+
+    specs = parse_plan("storage.put:corrupt=truncate@match=sidecar")
+    assert specs[0].action == "corrupt" and specs[0].arg == "truncate"
+    with pytest.raises(PlanSyntaxError):
+        parse_plan("storage.put:corrupt=zero")
+    with pytest.raises(PlanSyntaxError):
+        parse_plan("storage.put:corrupt")
+
+
+# ------------------------------------------------------------ restore errors
+
+
+def test_restore_error_carries_context(_storage):
+    ti = TaskInfo("intg-re", "src", "source", 0, 1)
+    tm = TableManager(ti, _storage)
+    tm.global_keyed("s").insert(0, 42)
+    tm.checkpoint(1, None)
+    write_job_checkpoint_metadata(_storage, "intg-re", 1,
+                                  {"operators": ["src"]})
+    path, _rel = _table_files(_storage, "intg-re", 1)[0]
+    _bitflip(path)
+    tm2 = TableManager(ti, _storage)
+    with pytest.raises(RestoreError) as ei:
+        tm2.restore(1, [TableSpec("s", "global_keyed")])
+    assert ei.value.epoch == 1
+    assert ei.value.operator == "src"
+    assert ei.value.path
+    assert ei.value.cause is not None
+
+
+def test_verify_off_skips_checksum_on_restore(_storage):
+    """state.integrity.verify = off: a bit-flipped artifact sails through
+    the ladder (operator chose to trust storage); fsck still catches it."""
+    from arroyo_tpu import config as cfg
+
+    _run_epochs("intg-off", n_epochs=1)
+    path, _rel = _table_files(_storage, "intg-off", 1)[0]
+    _bitflip(path)
+    cfg.update({"state.integrity.verify": "off"})
+    try:
+        epoch, skipped = latest_valid_checkpoint(_storage, "intg-off")
+        assert epoch == 1 and skipped == []
+    finally:
+        cfg.update({"state.integrity.verify": "restore"})
+    assert any(d.rule_id == "FS005" for d in _errors(fsck_job(
+        _storage, "intg-off")))
+
+
+# ------------------------------------------------------------------- fsck IO
+
+
+def test_fsck_cli_json_round_trip(_storage, capsys):
+    _run_epochs("intg-json", n_epochs=1)
+    path, _rel = _table_files(_storage, "intg-json", 1)[0]
+    _bitflip(path)
+
+    from arroyo_tpu.cli import main
+
+    rc = main(["fsck", "intg-json", "--storage-url", _storage, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1  # ERROR findings exit 1, matching `lint`
+    payload = json.loads(out)
+    assert isinstance(payload, list) and payload
+    for d in payload:
+        assert set(d) == {"rule", "severity", "site", "message", "hint"}
+    assert any(d["rule"] == "FS005" and d["severity"] == "error"
+               for d in payload)
+
+
+def test_fsck_api_endpoint(_storage):
+    from arroyo_tpu.api.server import ApiServer
+    from arroyo_tpu.controller import Database
+
+    _run_epochs("intg-api", n_epochs=1)
+    srv = ApiServer(Database(":memory:"), port=0)
+    srv.start()
+    try:
+        import urllib.request
+        from urllib.parse import quote
+
+        url = (f"http://127.0.0.1:{srv.port}/api/v1/jobs/intg-api/fsck"
+               f"?storage_url={quote(_storage, safe='')}")
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body["job_id"] == "intg-api"
+        assert body["clean"] is True
+
+        path, _rel = _table_files(_storage, "intg-api", 1)[0]
+        _bitflip(path)
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body["clean"] is False
+        assert any(d["rule"] == "FS005" for d in body["diagnostics"])
+    finally:
+        srv.stop()
+
+
+# -------------------------------------------------------- unified bad_data
+
+
+def test_bad_data_drop_counts_metric_and_event(_storage):
+    from arroyo_tpu.formats.registry import make_deserializer
+    from arroyo_tpu.metrics import registry
+    from arroyo_tpu.obs.events import recorder
+
+    schema = Schema.of([("v", "int64"), (TIMESTAMP_FIELD, "int64")])
+    ti = TaskInfo("intg-bad", "src", "source", 0, 1)
+    registry.clear_job("intg-bad")
+    de = make_deserializer({"format": "json", "bad_data": "drop"},
+                           schema, task_info=ti)
+    de.deserialize(b"{not json")
+    de.deserialize(b"{still not json")
+    assert de.errors == 2
+    assert registry.bad_records("intg-bad") == {"src": 2}
+    line = f'arroyo_bad_records_total{{job="intg-bad",operator="src"}} 2'
+    assert line in registry.prometheus_text()
+    evs = [e for e in recorder.events("intg-bad")
+           if e["code"] == "BAD_DATA_DROPPED"]
+    # throttled: the first drop emits, the second rides the 30s window
+    assert len(evs) == 1
+    assert evs[0]["data"]["dropped"] == 1
+    registry.clear_job("intg-bad")
+    assert registry.bad_records("intg-bad") == {}
+
+
+def test_bad_data_fail_still_raises(_storage):
+    from arroyo_tpu.formats.registry import make_deserializer
+
+    schema = Schema.of([("v", "int64"), (TIMESTAMP_FIELD, "int64")])
+    de = make_deserializer({"format": "json"}, schema,
+                           task_info=TaskInfo("intg-bad2", "src", "source",
+                                              0, 1))
+    with pytest.raises(Exception):
+        de.deserialize(b"{nope")
+    assert de.drop_bad_data(RuntimeError("transport")) is False
+
+
+def test_transport_errors_share_the_drop_policy(_storage):
+    """drop_bad_data is the transport-layer entry (http_conn routes its
+    request failures through it): counted exactly like decode errors."""
+    from arroyo_tpu.formats.registry import make_deserializer
+    from arroyo_tpu.metrics import registry
+
+    schema = Schema.of([("v", "int64"), (TIMESTAMP_FIELD, "int64")])
+    ti = TaskInfo("intg-bad3", "src", "source", 0, 1)
+    registry.clear_job("intg-bad3")
+    de = make_deserializer({"format": "json", "bad_data": "drop"},
+                           schema, task_info=ti)
+    assert de.drop_bad_data(ConnectionError("reset")) is True
+    assert registry.bad_records("intg-bad3") == {"src": 1}
+    registry.clear_job("intg-bad3")

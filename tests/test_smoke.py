@@ -216,6 +216,20 @@ def assert_commit_after_durable(event_log):
     assert commits, f"no commits were ever fanned out: {event_log}"
 
 
+def assert_fsck_clean(job_id):
+    """Post-chaos invariant: whatever the fault tore, the surviving
+    checkpoint chain must fsck clean — no FS-series ERROR (torn epochs and
+    GC-owned debris are warnings by design; actual corruption is not)."""
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.analysis import Severity
+    from arroyo_tpu.state.integrity import fsck_job
+
+    storage_url = cfg.config().get("checkpoint.storage-url")
+    errs = [d.render() for d in fsck_job(storage_url, job_id)
+            if d.severity == Severity.ERROR]
+    assert not errs, f"post-chaos fsck found corruption: {errs}"
+
+
 @pytest.mark.chaos
 @pytest.mark.parametrize("name", CHAOS_FAMILIES)
 def test_chaos_worker_crash_mid_checkpoint(name, tmp_path, _storage):
@@ -249,6 +263,7 @@ def test_chaos_worker_crash_mid_checkpoint(name, tmp_path, _storage):
     eng2 = build(sql, 2, job_id, restore_epoch=1)
     eng2.run_to_completion(timeout=180)
     assert_outputs(name, out)
+    assert_fsck_clean(job_id)
 
 
 @pytest.mark.chaos
@@ -324,6 +339,7 @@ def test_chaos_dataplane_partition_mid_stream(name, tmp_path, _storage):
         rm0.close()
         rm1.close()
     assert_outputs(name, out)
+    assert_fsck_clean(job_id)
 
 
 @pytest.mark.chaos
@@ -379,6 +395,7 @@ def test_chaos_worker_set_crash_mid_checkpoint(name, tmp_path, _storage):
     # worker-set incarnations (the log survives the restore)
     assert_commit_after_durable(jc.checkpoint_event_log)
     assert_outputs(name, out)
+    assert_fsck_clean(jid)
 
 
 @pytest.mark.chaos
@@ -423,6 +440,7 @@ def test_chaos_storage_fail_mid_compaction(name, tmp_path, _storage):
     eng2 = build(sql, 2, job_id, restore_epoch=2)
     eng2.run_to_completion(timeout=180)
     assert_outputs(name, out)
+    assert_fsck_clean(job_id)
 
 
 # ------------------------------------------------------------- fail cases

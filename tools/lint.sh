@@ -65,6 +65,7 @@ NAME_RE = re.compile(r"arroyo_(?:worker|checkpoint)_[a-z0-9_]+"
                      r"|arroyo_segment_[a-z0-9_]+"
                      r"|arroyo_spill_[a-z0-9_]+"
                      r"|arroyo_fleet_[a-z0-9_]+"
+                     r"|arroyo_bad_records_total"
                      r"|arroyo_events_total")
 code_names: set[str] = set()
 for p in glob.glob("arroyo_tpu/**/*.py", recursive=True):
@@ -141,14 +142,16 @@ from arroyo_tpu.analysis import (AUDIT_RULES, CONCURRENCY_RULES, LINT_RULES,
 
 # every rule id an analysis engine can emit: the four registered rule
 # tables, plus AR-series literals AST-walked out of the plan passes (they
-# register by function, not id) — each must appear in a README rule table
+# register by function, not id) and the FS-series fsck rules (emitted as
+# literals in state/integrity.py) — each must appear in a README rule table
 rule_ids = {rid for rid, _sev, _fn in LINT_RULES} | set(AUDIT_RULES) \
     | set(TRACE_RULES) | set(CONCURRENCY_RULES)
-ID_RE = re.compile(r"^(AR|LR)\d{3}$")
+ID_RE = re.compile(r"^(AR|LR|FS)\d{3}$")
 for p in ("arroyo_tpu/analysis/plan_passes.py",
           "arroyo_tpu/analysis/plan_diff.py",
           "arroyo_tpu/analysis/trace_audit.py",
-          "arroyo_tpu/analysis/__init__.py"):
+          "arroyo_tpu/analysis/__init__.py",
+          "arroyo_tpu/state/integrity.py"):
     with open(p) as f:
         tree = ast.parse(f.read(), p)
     for n in ast.walk(tree):
