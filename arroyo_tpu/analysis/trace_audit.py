@@ -279,6 +279,16 @@ def _fn_args_of_call(call: ast.Call) -> list[str]:
     return [a.id for a in call.args if isinstance(a, ast.Name)]
 
 
+def _is_shard_map(canon: str) -> bool:
+    """shard_map wraps its function argument for per-shard tracing, so a
+    shard_map call site is a jit root exactly like jit()/pjit() — whether
+    spelled jax.experimental.shard_map.shard_map, jax.shard_map, a bare
+    import, or a leading-underscore version-compat alias (the repo's own
+    parallel/sharded_agg.py ``_shard_map``). Without this the fused mesh
+    step's per-shard body would escape LR301-LR305 entirely."""
+    return canon.rsplit(".", 1)[-1].lstrip("_") == "shard_map"
+
+
 def _find_roots(index: _Index, mods: list[ModuleInfo]
                 ) -> tuple[list[FnInfo], set[str]]:
     """Trace roots + the set of relpaths containing a JIT call site (the
@@ -295,13 +305,15 @@ def _find_roots(index: _Index, mods: list[ModuleInfo]
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in n.decorator_list:
                     d = dec.func if isinstance(dec, ast.Call) else dec
-                    if _canon(mod, d) in _JIT_NAMES:
+                    dc = _canon(mod, d)
+                    if dc in _JIT_NAMES or _is_shard_map(dc):
                         root_by_name(n.name, mod.relpath)
                         jit_modules.add(mod.relpath)
             if not isinstance(n, ast.Call):
                 continue
             canon = _canon(mod, n.func)
-            if canon in _JIT_NAMES or canon.endswith((".jit", ".pjit")):
+            if canon in _JIT_NAMES or canon.endswith((".jit", ".pjit")) \
+                    or _is_shard_map(canon):
                 jit_modules.add(mod.relpath)
                 for a in n.args:
                     if isinstance(a, ast.Name):

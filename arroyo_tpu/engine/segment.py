@@ -162,12 +162,27 @@ def segment_marking(members: list[tuple[str, dict]]) -> Optional[dict]:
     """Static compilability of a chained run: the maximal traceable PREFIX
     of the member list, judged by op kind and expression shape (runtime
     still gates on actual column dtypes and verifies the first batch).
-    Returns ``{"prefix": k, "insert": bool, "stop": reason}`` when the
-    prefix is worth compiling (>= 2 members), else None."""
+    Returns ``{"prefix": k, "insert": bool, "stop": reason, "mesh": bool}``
+    when the prefix is worth compiling (>= 2 members), else None."""
     k, insert, stop = _scan_members(members)
     if k < 2:
         return None
-    return {"prefix": k, "insert": insert, "stop": stop}
+    return {"prefix": k, "insert": insert, "stop": stop,
+            "mesh": insert and _mesh_markable(members, k)}
+
+
+def _mesh_markable(members: list[tuple[str, dict]], k: int) -> bool:
+    """Static half of the mesh-fusion gate: can this insert-terminated
+    prefix run as ONE shard_map'd program feeding the sharded aggregate
+    in-program? In-trace filters ban it — the fused step commits rows on
+    device, so the host prologue (late split, open-bin bookkeeping) must
+    see exactly the rows the program inserts. The LEADING member's filter
+    is fine (the mesh path force-hoists it to the host); any later
+    member's filter has nowhere to go."""
+    for op, cfg in members[1:k]:
+        if op == OpName.VALUE.value and cfg.get("filter") is not None:
+            return False
+    return True
 
 
 def segment_reject_reason(members: list[tuple[str, dict]]) -> Optional[str]:
@@ -804,6 +819,24 @@ class _Fallback:
 # ----------------------------------------------------------------- runner
 
 
+# per-process micro-batch commit counts for mesh-armed runners: "fused" =
+# committed through the ONE shard_map'd program, "host" = committed through
+# the per-batch host path (first-batch verification, small batches, post-
+# failure recovery). bench.py --mesh-ab embeds these so "one jitted call
+# per step" is provable from the artifact, and the mesh tests assert the
+# fused path actually engaged (a silently-host run would still be correct).
+_MESH_DISPATCH = {"fused": 0, "host": 0}
+
+
+def mesh_dispatch_counts() -> dict:
+    return dict(_MESH_DISPATCH)
+
+
+def reset_mesh_dispatch_counts() -> None:
+    for k in _MESH_DISPATCH:
+        _MESH_DISPATCH[k] = 0
+
+
 class SegmentRunner:
     """Per-task driver: owns the compile/fallback decision for one chained
     operator and runs the compiled function per batch. The task run loop
@@ -823,14 +856,31 @@ class SegmentRunner:
         # stream too selective for the jit to pay; latch to interpreted so
         # later batches stop paying a throwaway filter evaluation
         self._small_streak = 0
+        # mesh fusion (device.mesh-devices > 1 + a mesh-markable insert
+        # prefix): the traced prefix runs per-shard inside the sharded
+        # aggregate's ONE shard_map'd program instead of as a host jit
+        # followed by a device exchange step. _mesh_n > 1 also forces the
+        # leading-filter hoist (_should_hoist) — the fused program has no
+        # mask output.
+        mesh_n = int(config().get("device.mesh-devices", 0) or 0)
+        self._mesh_n = (
+            mesh_n if mesh_n > 1 and marking.get("mesh")
+            and bool(config().get("segment.compile.mesh-fuse", True)) else 0)
+        self._mesh_prog = None  # jitted shard_map step (armed by _setup_mesh)
+        self._mesh_agg = None
+        self._mesh_member = None
+        self._mesh_off = False  # latched: fusion declined/failed, host path only
+        self._mesh_shapes: set[int] = set()
         # cache identity: the traced prefix's configs (tail members never
         # enter the trace — their configs may hold run-local objects) plus
         # the node's parallelism, so a rescale recompiles rather than
-        # reusing a trace whose key semantics could differ
+        # reusing a trace whose key semantics could differ. The mesh width
+        # keys too: a resize changes the forced-hoist decision and the
+        # owner-range layout the fused program bakes in.
         cfgs = [(op, _cfg_fingerprint(c))
                 for op, c in chain.cfg_members[: int(marking["prefix"])]]
         self._seg_key = hashlib.sha1(json.dumps(
-            [cfgs, ctx.task_info.parallelism], default=repr,
+            [cfgs, ctx.task_info.parallelism, self._mesh_n], default=repr,
         ).encode()).hexdigest()[:16]
 
     # -- events ---------------------------------------------------------
@@ -870,6 +920,8 @@ class SegmentRunner:
                 # vacuous first batch (hoisted filter left no survivors):
                 # a no-op on both paths; compile retries on the next batch
                 return
+        if self._mesh_prog is not None and self._mesh_execute(batch, collector):
+            return
         try:
             # pure: a trace/XLA failure here (e.g. a new padded shape
             # compiling under memory pressure) has mutated nothing, so it
@@ -925,6 +977,7 @@ class SegmentRunner:
             registry.add_segment_cache_hit(self.ctx.task_info.job_id)
             self._entry, self._sig = entry, sig
             self.metrics.segment_compiled = True
+            self._setup_mesh(entry)
             # the event feed is per-job: a job served from the process-wide
             # cache must still be diagnosable as compiled from `logs` alone
             self._event(
@@ -969,6 +1022,7 @@ class SegmentRunner:
         registry.observe_segment_compile(self.ctx.task_info.job_id, elapsed)
         self._entry, self._sig = entry, sig
         self.metrics.segment_compiled = True
+        self._setup_mesh(entry)
         self._event(
             "INFO", "SEGMENT_COMPILED",
             f"segment {self.chain.name()} compiled to one jitted call "
@@ -989,6 +1043,11 @@ class SegmentRunner:
 
         if not isinstance(m0, ValueOperator) or m0.filter is None:
             return False
+        if self._mesh_n > 1:
+            # mesh fusion: the fused shard_map program has no mask output,
+            # so a leading filter MUST run on the host. Cache keys include
+            # the mesh width, so entries never cross hoist decisions.
+            return True
         if expr_traceable(m0.filter) is not None:
             return True
         for name in m0.filter.columns():
@@ -1030,6 +1089,249 @@ class SegmentRunner:
             f"segment {self.chain.name()} fell back to the interpreted "
             f"path: {reason}", reason=reason)
 
+    # -- mesh fusion ----------------------------------------------------
+
+    def _setup_mesh(self, entry: CompiledSegment) -> None:
+        """Arm the fused mesh path for a freshly adopted entry: ONE
+        shard_map'd jitted program that runs the traced prefix per shard
+        and feeds the sharded aggregate's owner bucketing → all_to_all →
+        sort_reduce/probe_merge directly in-program, so rows never
+        round-trip to host between projection and state update. Fusion is
+        an optimization on top of the verified per-batch path, not a mode
+        switch: any gate failure quietly stays on the host path (no
+        SEGMENT_FALLBACK — the segment is still compiled)."""
+        self._mesh_prog = None
+        self._mesh_agg = None
+        self._mesh_member = None
+        if self._mesh_n <= 1 or self._mesh_off:
+            return
+        plan = entry.plan
+        if plan.insert is None:
+            self._mesh_off = True
+            return
+        # the member resolves BY INDEX against THIS chain (same rule as
+        # _commit): a cache-hit entry was bound by another incarnation
+        member = self.chain.members[plan.insert.member_index]
+        from ..parallel.sharded_agg import ShardedAggregator
+
+        # the window operators build their store lazily on first insert;
+        # setup runs before the verified first batch commits, so force the
+        # construction (same path an insert would take) to see its type
+        agg_fn = getattr(member, "_aggregator", None)
+        agg = agg_fn() if agg_fn is not None else getattr(member, "_agg", None)
+        if not isinstance(agg, ShardedAggregator):
+            # mesh-devices was toggled after the operator built its store,
+            # or the backend fell back — the host path still works
+            self._mesh_off = True
+            return
+        for si, st in enumerate(plan.stages):
+            if (st.kind == "value" and st.member.filter is not None
+                    and (si != 0 or plan.prefilter is None)):
+                # an in-trace filter would desync the host prologue (late
+                # split, open-bin bookkeeping) from the rows the program
+                # inserts; _mesh_markable bans this statically, but a
+                # cache entry bound under different config could disagree
+                self._mesh_off = True
+                return
+        # the host prologue derives bins from the VERBATIM event time, so
+        # the insert-time _timestamp must be the input column untouched: a
+        # projection that redefines it (prov walk in _bind) cannot fuse —
+        # and "in traced_in" alone doesn't prove it (an earlier stage may
+        # have consumed the verbatim column before a projection shadowed it)
+        ts_verbatim = TIMESTAMP_FIELD in plan.traced_in
+        for st in plan.stages:
+            if (st.kind == "value" and st.member.projections is not None
+                    and any(name == TIMESTAMP_FIELD
+                            for name, _e in st.member.projections)):
+                ts_verbatim = False
+        if not ts_verbatim:
+            self._mesh_off = True
+            return
+        if getattr(member, "mesh_insert_begin", None) is None:
+            self._mesh_off = True
+            return
+        try:
+            prefix_fn = self._build_mesh_prefix(plan, member)
+            self._mesh_prog = agg.fused_step(
+                prefix_fn, len(plan.traced_in), 2 * len(plan.wm_stages))
+            self._mesh_agg = agg
+            self._mesh_member = member
+            self._mesh_shapes = set()
+        except Exception as e:  # noqa: BLE001 - fusion is best-effort
+            self._mesh_off = True
+            self._event(
+                "WARN", "SEGMENT_FALLBACK",
+                f"segment {self.chain.name()} mesh fusion disabled "
+                f"(compiled host path continues): {type(e).__name__}: {e}",
+                reason=str(e), mesh=True)
+
+    def _build_mesh_prefix(self, plan: _SegmentPlan, member) -> Callable:
+        """The traced prefix re-expressed as the sharded step's in-program
+        prologue: a per-shard twin of ``_trace_fn`` minus filter stages
+        (banned by the mesh gate), producing the insert columns the
+        exchange+merge consumes.
+
+        Contract (parallel.sharded_agg.ShardedAggregator.fused_step):
+        ``prefix_fn(arrays, valid, base_bin, ontime) -> (key_i64,
+        bins_i32, insert_valid, vals, aux)`` where ``valid`` masks this
+        shard's padding rows, ``ontime`` masks host-detected late rows
+        (insert only — the watermark observes PRE-late rows, matching the
+        interpreted order where the generator sits upstream of the
+        window), and ``aux`` is one (masked max, valid count) pair per
+        watermark stage."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops import require_x64
+
+        require_x64()
+        step_us = _insert_step(member)
+        stages = list(plan.stages)
+        traced_in = list(plan.traced_in)
+        insert_has_key = plan.insert_has_key
+        acc = list(zip(member.acc_inputs, member.acc_dtypes))
+
+        def prefix_fn(arrays, valid, base_bin, ontime):
+            p = arrays[0].shape[0]
+            cols: dict[str, Any] = dict(zip(traced_in, arrays))
+            aux: list[Any] = []
+            key_i64 = None
+            bins = None
+            vals: list[Any] = []
+            for st in stages:
+                m = st.member
+                if st.kind == "value":
+                    if m.projections is not None:
+                        new = {}
+                        for name, e in m.projections:
+                            new[name] = _as_full(e.eval_jnp(cols), p)
+                        for carried in (TIMESTAMP_FIELD, KEY_FIELD,
+                                        "_is_retract"):
+                            if carried not in new and carried in cols:
+                                new[carried] = cols[carried]
+                        cols = new
+                elif st.kind == "key":
+                    key_cols = []
+                    for name, e in m.keys:
+                        c = _as_full(e.eval_jnp(cols), p)
+                        cols[name] = c
+                        key_cols.append(c)
+                    cols[KEY_FIELD] = _hash_columns_jnp(key_cols)
+                elif st.kind == "wm":
+                    wvals = _as_full(m.expr.eval_jnp(cols), p)
+                    floor = _dtype_floor(np.dtype(wvals.dtype))
+                    aux.extend([jnp.max(jnp.where(valid, wvals, floor)),
+                                jnp.sum(valid)])
+                else:  # insert: rel bins in int32, like the host twins
+                    bins = (cols[TIMESTAMP_FIELD] // step_us
+                            - base_bin).astype(jnp.int32)
+                    if insert_has_key:
+                        # signed transport twin of the host .view(np.int64)
+                        key_i64 = lax.bitcast_convert_type(
+                            cols[KEY_FIELD].astype(jnp.uint64), jnp.int64)
+                    for inp, dt in acc:
+                        if inp is None:
+                            vals.append(jnp.ones(p, dtype=dt))
+                        else:
+                            vals.append(
+                                _as_full(inp.eval_jnp(cols), p).astype(dt))
+            if key_i64 is None:
+                key_i64 = jnp.zeros(p, dtype=jnp.int64)
+            return key_i64, bins, valid & ontime, tuple(vals), tuple(aux)
+
+        return prefix_fn
+
+    def _mesh_execute(self, batch: Batch, collector) -> bool:
+        """One fused micro-batch: host prologue (hoisted filter, late
+        split, open-bin bookkeeping via the member's mesh_insert_begin),
+        then ONE jitted shard_map dispatch running projection → key hash →
+        owner exchange → merge entirely on device. Returns False to hand
+        the batch to the per-batch host path, which recovers it exactly:
+        a failed fused call never committed aggregate state, and the
+        member prologue's bookkeeping (late counter, open-bin set) is
+        idempotent under the host re-run."""
+        plan = self._entry.plan
+        member = self._mesh_member
+        agg = self._mesh_agg
+        n = batch.num_rows
+        fmask = None
+        if plan.prefilter is not None:
+            fm = np.asarray(
+                eval_expr(plan.prefilter, batch.columns, n), dtype=bool)
+            if not fm.any():
+                self._small_streak = 0
+                return True  # nothing flows on either path
+            if not fm.all():
+                survivors = int(fm.sum())
+                if survivors < max(1, self._min_rows):
+                    return False  # host path owns the small-batch latch
+                fmask = fm
+                n = survivors
+        try:
+            ts = np.asarray(batch.columns[TIMESTAMP_FIELD])
+            if fmask is not None:
+                ts = ts[fmask]
+            bins_abs = ts // _insert_step(member)
+            mcols = self.chain._chain_cols(collector)
+            ontime = member.mesh_insert_begin(
+                bins_abs, mcols[plan.insert.member_index])
+            p = _padded_size(n)
+            if p % agg.n_dev:
+                p = -(-p // agg.n_dev) * agg.n_dev
+            shard = p // agg.n_dev
+            arrays = []
+            for name in plan.traced_in:
+                a = np.asarray(batch.columns[name])
+                buf = np.zeros(p, dtype=a.dtype)
+                if fmask is not None:
+                    np.compress(fmask, a, out=buf[:n])
+                else:
+                    buf[:n] = a
+                arrays.append(buf.reshape(agg.n_dev, shard))
+            ot = np.zeros(p, dtype=bool)
+            ot[:n] = True if ontime is None else ontime
+            ot = ot.reshape(agg.n_dev, shard)
+            with self._entry._lock:
+                new_shape = p not in self._mesh_shapes
+                self._mesh_shapes.add(p)
+            t0 = time.perf_counter()
+            aux = agg.update_fused(
+                self._mesh_prog, n,
+                0 if member.base_bin is None else int(member.base_bin),
+                ot, arrays)
+            if new_shape:
+                # per-shape XLA compile of the fused program, same series
+                # as the host entry's retraces
+                from ..metrics import registry
+
+                registry.observe_segment_compile(
+                    self.ctx.task_info.job_id, time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 - fusion is best-effort
+            self._mesh_prog = None
+            self._mesh_off = True
+            self._event(
+                "WARN", "SEGMENT_FALLBACK",
+                f"segment {self.chain.name()} fused mesh step failed; "
+                f"batches continue on the compiled host path: "
+                f"{type(e).__name__}: {e}", reason=str(e), mesh=True)
+            return False
+        pairs = []
+        it = iter(aux)
+        for mx in it:
+            cnt = np.asarray(next(it))
+            total = int(cnt.sum())
+            # exact across shards: empty shards report the dtype floor,
+            # which never exceeds a real value
+            pairs.append((int(np.asarray(mx).max()) if total else None, total))
+        for st, (mx, cnt) in zip(reversed(plan.wm_stages), reversed(pairs)):
+            if cnt:
+                self.chain.members[st.member_index].observe_batch_max(
+                    mx, mcols[st.member_index])
+        self._small_streak = 0
+        self.metrics.segment_mesh = True
+        _MESH_DISPATCH["fused"] += 1
+        return True
+
     # -- host finish ----------------------------------------------------
 
     def _commit(self, res: dict, collector) -> None:
@@ -1046,6 +1348,8 @@ class SegmentRunner:
         while this chain's operators — the ones that checkpoint — see
         nothing. The traced function itself is pure, so reusing it across
         incarnations is safe; only the state sinks must be re-resolved."""
+        if self._mesh_n > 1:
+            _MESH_DISPATCH["host"] += 1
         chain = self.chain
         cols = chain._chain_cols(collector)
         plan = self._entry.plan

@@ -293,8 +293,15 @@ class Task:
         # marked compilable at plan time processes batches through ONE
         # jitted call instead of the per-member hook loop; the runner owns
         # compile/verify/fallback and delegates to op.process_batch when
-        # the segment is (or becomes) interpreted. Signals below always
-        # take the interpreted hooks.
+        # the segment is (or becomes) interpreted. On a mesh-marked
+        # segment over a sharded aggregate the runner goes one further:
+        # the traced prefix AND the keyed exchange/merge run as one
+        # shard_map'd jitted program per micro-batch, so the device never
+        # round-trips rows to the host between segment and aggregate.
+        # Signals below always take the interpreted hooks — a checkpoint
+        # barrier snapshots through the operator, which reads back
+        # canonical (placement-independent) state, keeping mesh-fused
+        # and host-path checkpoints byte-identical.
         from .segment import runner_for
 
         runner = runner_for(op, self.ctx, self.metrics)

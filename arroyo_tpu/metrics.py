@@ -113,7 +113,7 @@ class TaskMetrics:
                  "sink_event_latency", "watermark_micros", "self_time",
                  "self_cpu", "late_rows", "state_rows", "state_bytes",
                  "sketch", "started_monotonic", "segment_compiled",
-                 "segment_reason", "spill")
+                 "segment_reason", "spill", "segment_mesh", "mesh")
 
     def __init__(self, job_id: str, node_id: str, subtask: int):
         self.job_id = job_id
@@ -160,6 +160,14 @@ class TaskMetrics:
         # "probe_files": Histogram}, set by TaskProfiler.refresh from the
         # operator's spill_stats() hook; None while nothing ever spilled
         self.spill: Optional[dict] = None
+        # fused mesh execution (engine/segment.py mesh path): True once
+        # this subtask committed a micro-batch through the ONE shard_map'd
+        # program — `top`/`explain` render the [mesh] marker from this
+        self.segment_mesh: Optional[bool] = None
+        # sharded-aggregate residency: {"exchange_rows", "overflow_rows"},
+        # set by TaskProfiler.refresh from the operator's mesh_stats()
+        # hook; None off the mesh path -> arroyo_mesh_* series
+        self.mesh: Optional[dict] = None
 
     def histogram(self, name: str) -> Histogram:
         # explicit mapping: an unknown/typoed name must fail loudly at the
@@ -390,6 +398,23 @@ class MetricsRegistry:
                     f'arroyo_spill_partitions{{{label},state="cold"}} '
                     f"{t.spill['cold']}")
 
+        # fused mesh execution (parallel/sharded_agg.py): rows fed through
+        # the in-program keyed exchange, and the current per-shard HBM
+        # spill-buffer residency (key skew past a fixed exchange lane)
+        mesh_tasks = [t for t in tasks if t.mesh]
+        if mesh_tasks:
+            lines.append("# TYPE arroyo_mesh_exchange_rows_total counter")
+            lines.append("# TYPE arroyo_mesh_overflow_rows gauge")
+            for t in mesh_tasks:
+                label = (f'job="{t.job_id}",operator="{t.node_id}",'
+                         f'subtask="{t.subtask}"')
+                lines.append(
+                    f"arroyo_mesh_exchange_rows_total{{{label}}} "
+                    f"{t.mesh.get('exchange_rows', 0)}")
+                lines.append(
+                    f"arroyo_mesh_overflow_rows{{{label}}} "
+                    f"{t.mesh.get('overflow_rows', 0)}")
+
         def emit_histogram(name: str, label: str, h: Histogram) -> None:
             cum = 0
             for le, c in zip(h.buckets, h.counts):
@@ -548,6 +573,10 @@ class MetricsRegistry:
                 entry["segment_compiled"] = t.segment_compiled
             if t.segment_reason is not None:
                 entry["segment_reason"] = t.segment_reason
+            if t.segment_mesh is not None:
+                entry["segment_mesh"] = t.segment_mesh
+            if t.mesh is not None:
+                entry["mesh"] = dict(t.mesh)
             if t.sketch is not None and t.sketch.total:
                 # fixed-width hex: merges deterministically (merge_topk) and
                 # survives JSON without 64-bit precision loss
@@ -590,6 +619,12 @@ def _op_aggregate(per_subtask: dict[str, dict]) -> dict:
     }
     if any(s.get("segment_compiled") for s in per_subtask.values()):
         out["segment_compiled"] = True
+    if any(s.get("segment_mesh") for s in per_subtask.values()):
+        out["segment_mesh"] = True
+    mesh = [s["mesh"] for s in per_subtask.values() if s.get("mesh")]
+    if mesh:
+        out["mesh"] = {k: sum(int(m.get(k, 0)) for m in mesh)
+                       for k in ("exchange_rows", "overflow_rows")}
     reasons = sorted({s["segment_reason"] for s in per_subtask.values()
                       if s.get("segment_reason")})
     if reasons:

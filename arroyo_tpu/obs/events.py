@@ -86,6 +86,13 @@ EVENT_CODES: dict[str, tuple[str, str]] = {
         "WARN", "a marked segment could not trace (or its first-batch "
                 "verification diverged) and degraded to the interpreted "
                 "per-operator path for this run; data carries the reason"),
+    "MESH_OVERFLOW": (
+        "WARN", "key skew pushed rows past the sharded aggregate's fixed-"
+                "capacity exchange lane into the per-shard HBM spill "
+                "buffer — correct but slower, and exhausting that buffer "
+                "IS an error, so raise device.spill-capacity first "
+                "(throttled: re-emitted only when the resident count "
+                "doubles; data: overflow_rows)"),
     "JOB_QUEUED": (
         "INFO", "the fleet could not place the job (pool full / tenant at "
                 "quota / placement 409'd) — it waits in its tenant's FIFO "

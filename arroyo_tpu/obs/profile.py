@@ -177,6 +177,11 @@ class TaskProfiler:
             # tiered state (state/spill.py): spilled bytes, hot/cold
             # partition split, and probe-pruning histogram -> arroyo_spill_*
             m.spill = spill()
+        mesh = getattr(self.op, "mesh_stats", None)
+        if mesh is not None:
+            # sharded mesh execution (parallel/sharded_agg.py): exchange
+            # throughput + spill-buffer residency -> arroyo_mesh_*
+            m.mesh = mesh()
 
 
 def make_profiler(metrics, task_info, table_manager, op) -> Optional[TaskProfiler]:
@@ -237,8 +242,12 @@ def job_profile(metrics: Optional[dict]) -> dict:
         }
         if m.get("segment_compiled"):
             out[op]["segment_compiled"] = True
+        if m.get("segment_mesh"):
+            out[op]["segment_mesh"] = True
         if m.get("segment_reason"):
             out[op]["segment_reason"] = m["segment_reason"]
+        if m.get("mesh"):
+            out[op]["mesh"] = m["mesh"]
     return out
 
 
@@ -307,6 +316,10 @@ def _annotations(prof: dict) -> list[str]:
         # whole-segment compilation: this row's self-time is ONE jitted
         # dispatch covering every chained member, not a per-member sum
         head = "[compiled] " + head
+        if prof.get("segment_mesh"):
+            # fused mesh execution: that one dispatch is a shard_map'd
+            # program covering the keyed exchange + state update too
+            head = "[mesh] " + head
     elif prof.get("segment_reason"):
         # the plan-time reject or runtime fallback reason: the segment is
         # interpreted, and this line says why (AR009 / SEGMENT_FALLBACK)

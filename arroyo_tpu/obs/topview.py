@@ -100,7 +100,10 @@ def render(job: dict, metrics: Optional[dict],
             # as one jitted dispatch (its busy% is not a per-member sum);
             # an uncompiled segment names its plan-time reject or runtime
             # fallback reason instead (truncated to keep the table narrow)
-            op + (" [compiled]" if m.get("segment_compiled")
+            # [mesh] = the dispatch is one shard_map'd program fusing the
+            # segment with the sharded aggregate's keyed exchange
+            op + ((" [mesh]" if m.get("segment_mesh") else "")
+                  + " [compiled]" if m.get("segment_compiled")
                   else not_compiled(m)
                   if m.get("segment_reason") else ""),
             str(m.get("subtasks", len(m.get("per_subtask", {})) or 1)),

@@ -129,6 +129,18 @@ class ChainedOperator(Operator):
             [fn() for m in self.members
              for fn in (getattr(m, "spill_stats", None),) if fn is not None])
 
+    def mesh_stats(self):
+        """Fused-mesh residency of the chain's window member, if any (the
+        sharded aggregate lives on exactly one member — obs/profile.py
+        exports this as the arroyo_mesh_* series)."""
+        for m in self.members:
+            fn = getattr(m, "mesh_stats", None)
+            if fn is not None:
+                stats = fn()
+                if stats is not None:
+                    return stats
+        return None
+
     def tables(self):
         specs = []
         for i, m in enumerate(self.members):

@@ -80,7 +80,12 @@ def chain_graph(g: Graph) -> Graph:
         # traceable prefix of the run, judged statically from op kinds and
         # expression shapes. The runtime still gates on real column dtypes
         # and verifies the first batch — this marking only says "worth
-        # attempting", so an unmarked chain never pays a compile probe
+        # attempting", so an unmarked chain never pays a compile probe.
+        # The marking's "mesh" field additionally says whether the prefix
+        # is shard_map-fusable with a sharded window aggregate (no
+        # in-trace filters past the hoistable head) — the runtime only
+        # builds the fused per-shard program when it is True AND
+        # device.mesh-devices > 1 picked a ShardedAggregator
         from .engine.segment import segment_marking, segment_reject_reason
 
         marking = segment_marking(members)

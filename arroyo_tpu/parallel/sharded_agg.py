@@ -53,6 +53,22 @@ from .mesh import KEY_AXIS
 
 _U64_MAX = (1 << 64) - 1
 
+# process-wide dispatch counters: how many jitted step programs ran, split
+# by entry path. bench.py --mesh-ab reads these to PROVE "one jitted call
+# per micro-batch step" from the artifact (a fused step is one program for
+# segment prefix + exchange + merge; a host step is one program for
+# exchange + merge with the prefix done on host).
+_DISPATCH = {"host_steps": 0, "fused_steps": 0}
+
+
+def dispatch_counts() -> dict:
+    return dict(_DISPATCH)
+
+
+def reset_dispatch_counts() -> None:
+    for k in _DISPATCH:
+        _DISPATCH[k] = 0
+
 
 def _shard_map(fn, mesh, in_specs, out_specs):
     import jax
@@ -145,21 +161,24 @@ class ShardedAggregator:
                 tuple(a[None] for a in sp_accs),
             )
 
-        def local_step(state, key, bins, valid, vals):
-            """Per-device body under shard_map (leading mesh dim is 1)."""
+        def exchange_merge(parts, key, bins, valid, vals, blen):
+            """The per-device exchange+merge body (steps 1-7), parametrized
+            by the STATIC per-shard row count ``blen`` so the same code
+            serves both the host-fed step (blen = batch_cap) and the fused
+            segment step (blen = the traced prefix's padded shard length).
+            ``parts`` is the unpacked (leading-dim-stripped) state tuple;
+            returns the updated parts."""
             (keys_t, bins_t, occ_t, accs_t, oflow_t,
-             sp_key, sp_bin, sp_fill, sp_accs) = unpack(state)
-            key, bins, valid = key[0], bins[0], valid[0]
-            vals = tuple(v[0] for v in vals)
+             sp_key, sp_bin, sp_fill, sp_accs) = parts
             # --- 1. local pre-aggregation
             u_key, u_bin, active, u_accs = sort_reduce(
-                acc_kinds_t, key, bins, valid, vals, batch_cap
+                acc_kinds_t, key, bins, valid, vals, blen
             )
             # --- 2. owners via contiguous u64 ranges (matching host
             # servers_for_hashes, including its n == 1 special case —
             # _U64_MAX // 1 + 1 would overflow uint64)
             if n_dev == 1:
-                owner = jnp.zeros(batch_cap, dtype=jnp.int32)
+                owner = jnp.zeros(blen, dtype=jnp.int32)
             else:
                 range_size = jnp.uint64(_U64_MAX // n_dev + 1)
                 owner = jnp.minimum(
@@ -170,7 +189,7 @@ class ShardedAggregator:
             order = jnp.argsort(owner)
             o_s = owner[order]
             starts = jnp.searchsorted(o_s, jnp.arange(n_dev, dtype=jnp.int32))
-            rank = jnp.arange(batch_cap, dtype=jnp.int32) - starts[
+            rank = jnp.arange(blen, dtype=jnp.int32) - starts[
                 jnp.clip(o_s, 0, n_dev - 1)
             ]
             sendable = (o_s < n_dev) & (rank < dest_cap)
@@ -214,7 +233,7 @@ class ShardedAggregator:
                 for i in range(len(acc_kinds_t))
             )
             c_key, c_bin, c_active, c_accs = sort_reduce(
-                acc_kinds_t, m_key, m_bin, m_valid, m_accs, recv_cap + batch_cap
+                acc_kinds_t, m_key, m_bin, m_valid, m_accs, recv_cap + blen
             )
             # --- 6. merge into the local table shard
             (keys_t, bins_t, occ_t, accs_t), still_active = probe_merge(
@@ -237,8 +256,16 @@ class ShardedAggregator:
             n_lost = jnp.sum(still_active, dtype=jnp.int32) - n_spilled
             sp_fill = jnp.minimum(sp_fill + n_spilled, spill_cap_)
             oflow_t = oflow_t + n_lost
-            return pack(keys_t, bins_t, occ_t, accs_t, oflow_t,
-                        sp_key, sp_bin, sp_fill, sp_accs)
+            return (keys_t, bins_t, occ_t, accs_t, oflow_t,
+                    sp_key, sp_bin, sp_fill, sp_accs)
+
+        def local_step(state, key, bins, valid, vals):
+            """Per-device body under shard_map (leading mesh dim is 1)."""
+            parts = unpack(state)
+            key, bins, valid = key[0], bins[0], valid[0]
+            vals = tuple(v[0] for v in vals)
+            return pack(*exchange_merge(parts, key, bins, valid, vals,
+                                        batch_cap))
 
         def spec_state():
             return (
@@ -258,6 +285,19 @@ class ShardedAggregator:
             ),
             donate_argnums=0,
         )
+        # fused-segment hook points (fused_step): the exchange+merge body,
+        # the state (un)packers, and the state/batch specs
+        self._exchange_merge = exchange_merge
+        self._unpack = unpack
+        self._pack = pack
+        self._spec_state = spec_state
+        self._spec_batch = spec_batch
+        # observability (mesh_stats -> arroyo_mesh_* series): rows fed
+        # through the keyed exchange, and the current spill-buffer residency
+        # (refreshed opportunistically wherever sp_fill is already on host —
+        # never a dedicated device sync)
+        self.exchange_rows = 0
+        self.overflow_rows = 0
 
         emit_cap_ = self.emit_cap
 
@@ -328,7 +368,79 @@ class ShardedAggregator:
     def update_sharded(self, key_i64, bins, valid, vals) -> None:
         """key_i64/bins/valid: [n_dev, batch_cap] (device-local rows);
         vals: one [n_dev, batch_cap] array per accumulator."""
+        _DISPATCH["host_steps"] += 1
         self.state = self._step(self.state, key_i64, bins, valid, tuple(vals))
+
+    # ------------------------------------------------------- fused segments
+
+    def fused_step(self, prefix_fn, n_inputs: int, n_aux: int):
+        """Build ONE shard_map'd jitted program fusing a traced segment
+        prefix (engine/segment.py mesh path) with this store's exchange+
+        merge: per-shard projection/key-hash -> owner bucketing ->
+        all_to_all -> sort_reduce/probe_merge, with no host round trip
+        between projection and state update.
+
+        ``prefix_fn(arrays, valid, base_bin, ontime) -> (key_i64, bins_i32,
+        insert_valid, vals_tuple, aux_tuple)`` runs per shard on
+        [P_dev]-length arrays (``n_inputs`` of them); ``aux_tuple`` is a
+        flat tuple of ``n_aux`` scalars (watermark max/count pairs over
+        PRE-late rows). Row validity (padding tail) is computed HERE from
+        the global row count so the prefix stays mesh-agnostic.
+
+        Returns ``step(state, n, base_bin, ontime2d, *arrays2d) ->
+        (state', aux_shards)`` — jitted, state donated, aux gathered as
+        one [n_dev] array per scalar. The caller runs it via
+        ``update_fused`` so counters stay correct.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as PS
+
+        exchange = self._exchange_merge
+        unpack, pack = self._unpack, self._pack
+
+        def local(state, n, base_bin, ontime, *arrays):
+            parts = unpack(state)
+            ontime = ontime[0]
+            arrays = tuple(a[0] for a in arrays)
+            pd = ontime.shape[0]
+            # this shard owns global rows [d*pd, (d+1)*pd); rows >= n are
+            # the padding tail (dtype pinned: LR304)
+            row0 = jax.lax.axis_index(KEY_AXIS).astype(jnp.int64) * pd
+            valid = (row0 + jnp.arange(pd, dtype=jnp.int64)) < n
+            key_i64, bins, ins_valid, vals, aux = prefix_fn(
+                arrays, valid, base_bin, ontime)
+            parts = exchange(parts, key_i64, bins, ins_valid, vals, pd)
+            return pack(*parts), tuple(jnp.asarray(a)[None] for a in aux)
+
+        sb = self._spec_batch
+        step = jax.jit(
+            _shard_map(
+                local, self.mesh,
+                in_specs=(self._spec_state(), PS(), PS(), sb)
+                + tuple(sb for _ in range(n_inputs)),
+                out_specs=(self._spec_state(),
+                           tuple(PS(KEY_AXIS) for _ in range(n_aux))),
+            ),
+            donate_argnums=0,
+        )
+        return step
+
+    def update_fused(self, step, n: int, base_bin: int, ontime, arrays):
+        """Run one fused segment+exchange program built by ``fused_step``;
+        ``ontime``/``arrays`` are [n_dev, P_dev]-shaped. Returns the
+        per-shard aux arrays ([n_dev] each, host numpy)."""
+        _DISPATCH["fused_steps"] += 1
+        self.exchange_rows += int(n)
+        self.state, aux = step(self.state, np.int64(n), np.int64(base_bin),
+                               ontime, *arrays)
+        return [np.asarray(a) for a in aux]
+
+    def mesh_stats(self) -> dict:
+        """Counters behind the arroyo_mesh_* series (obs/profile.py reads
+        this through the operator's mesh_stats hook)."""
+        return {"exchange_rows": self.exchange_rows,
+                "overflow_rows": self.overflow_rows}
 
     def _drain_spill(self, emit_lo: int, emit_hi: int, free_below: int):
         """Host-side spill-buffer drain: gather the (small) per-shard spill
@@ -342,6 +454,7 @@ class ShardedAggregator:
          sp_key, sp_bin, sp_fill, sp_accs) = self.state
         fill = np.asarray(sp_fill)
         if int(fill.sum()) == 0:
+            self.overflow_rows = 0
             return (np.empty(0, dtype=np.uint64), np.empty(0, dtype=np.int32),
                     [np.empty(0, dtype=d) for d in self.acc_dtypes])
         k = np.asarray(sp_key)
@@ -367,6 +480,7 @@ class ShardedAggregator:
             new_b[d_i, :m] = b[d_i, sel]
             for j in range(len(accs)):
                 new_accs[j][d_i, :m] = accs[j][d_i, sel]
+        self.overflow_rows = int(new_fill.sum())
         shard = NamedSharding(self.mesh, PS(KEY_AXIS, None))
         shard1 = NamedSharding(self.mesh, PS(KEY_AXIS))
         self.state = (
@@ -442,6 +556,7 @@ class ShardedAggregator:
             yield k, b, valid, vs
 
     def update(self, key_u64, bins, vals) -> None:
+        self.exchange_rows += len(key_u64)
         key_i64 = np.ascontiguousarray(key_u64, dtype=np.uint64).view(np.int64)
         bins = np.asarray(bins, dtype=np.int32)
         vals = [np.asarray(v, dtype=d) for v, d in zip(vals, self.acc_dtypes)]
@@ -474,6 +589,7 @@ class ShardedAggregator:
         bins = np.asarray(bins_t)[occ].astype(np.int32)
         accs = [np.asarray(a)[occ] for a in accs_t]
         fill = np.asarray(sp_fill)
+        self.overflow_rows = int(fill.sum())
         if int(fill.sum()):
             in_fill = np.arange(self.spill_cap)[None, :] < fill[:, None]
             keys = np.concatenate([keys, np.asarray(sp_key)[in_fill].view(np.uint64)])

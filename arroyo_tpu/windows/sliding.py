@@ -31,7 +31,8 @@ from ..graph import OpName
 from ..operators.base import Operator, TableSpec, persist_mark, restore_marks
 from ..types import Watermark
 from .tumbling import (WINDOW_END, WINDOW_START, KeyDictionary, acc_plan,
-                       dtype_of_from_config, make_window_aggregator)
+                       dtype_of_from_config, make_window_aggregator,
+                       record_mesh_overflow)
 
 
 class SlidingAggregate(Operator):
@@ -70,6 +71,7 @@ class SlidingAggregate(Operator):
         self.max_bin: Optional[int] = None  # latest rel bin seen
         self.next_window: Optional[int] = None  # rel start-bin of next window to emit
         self.late_rows = 0  # state: ephemeral — observability counter (obs/profile.py export); never read into emitted data
+        self._mesh_oflow_hwm = 0  # state: ephemeral — MESH_OVERFLOW event throttle high-water mark
         # device-path incremental extraction: each slide bin is fetched from
         # the device EXACTLY ONCE (destructively) when the watermark completes
         # it, asynchronously via the shared prefetcher; windows combine the
@@ -256,6 +258,47 @@ class SlidingAggregate(Operator):
         self.max_bin = hi if self.max_bin is None else max(self.max_bin, hi)
         if self.next_window is None:
             self.next_window = self.min_bin - self.nb + 1
+
+    def mesh_insert_begin(self, bins_abs, collector):
+        """Host half of the FUSED mesh step (same contract as
+        TumblingAggregate.mesh_insert_begin): drain, base-bin anchor, late
+        split, bin bookkeeping — the aggregator update itself runs inside
+        the shard_map'd program. Mirrors insert_arrays statement for
+        statement (late compare in int64 BEFORE the int32 cast) so the
+        late boundary and checkpoints stay byte-identical."""
+        if self._bin_pending or self._wm_queue:
+            self._drain(collector)
+        if len(bins_abs) == 0:
+            return None
+        if self.base_bin is None:
+            self.base_bin = int(bins_abs.min())
+        rel = bins_abs - self.base_bin
+        late_before = self.next_window
+        if self._late_before is not None:
+            late_before = (self._late_before if late_before is None
+                           else max(late_before, self._late_before))
+        ontime = None
+        if late_before is not None:
+            late = rel < late_before
+            if late.any():
+                self.late_rows += int(late.sum())
+                ontime = ~late
+                rel = rel[ontime]
+        if len(rel) == 0:
+            return ontime
+        rel = rel.astype(np.int32)
+        self.open_bins.update(np.unique(rel).tolist())
+        lo, hi = int(rel.min()), int(rel.max())
+        self.min_bin = lo if self.min_bin is None else min(self.min_bin, lo)
+        self.max_bin = hi if self.max_bin is None else max(self.max_bin, hi)
+        if self.next_window is None:
+            self.next_window = self.min_bin - self.nb + 1
+        return ontime
+
+    def mesh_stats(self):
+        """Mesh-execution residency counters (None off the sharded path)."""
+        stats = getattr(self._agg, "mesh_stats", None)
+        return stats() if stats is not None else None
 
     def handle_watermark(self, watermark, ctx, collector):
         if watermark.is_idle:
@@ -491,6 +534,7 @@ class SlidingAggregate(Operator):
             tbl.replace_all([])
             return
         keys, bins, accs = self._aggregator().snapshot()
+        record_mesh_overflow(self, ctx)
         cached = sorted(self._bin_cache)
         if cached:
             keys = np.concatenate([keys] + [self._bin_cache[b][0] for b in cached])

@@ -152,6 +152,34 @@ class CollectingAggregator:
                 ent[j] = list(accs[i][row])
 
 
+def record_mesh_overflow(op, ctx) -> int:
+    """Throttled MESH_OVERFLOW WARN, called from the window operators'
+    handle_checkpoint right after the snapshot (which refreshes the sharded
+    store's spill residency with no extra device sync). Key skew past a
+    fixed-capacity exchange lane parks rows in the per-shard HBM spill
+    buffer — correct but slower, and the operator should hear about it
+    before the buffer itself fills (which IS an error). The doubling
+    high-water mark keeps a steadily-skewed job from flooding the feed."""
+    stats_fn = getattr(op._agg, "mesh_stats", None)
+    if stats_fn is None:
+        return 0
+    rows = int(stats_fn().get("overflow_rows", 0))
+    if rows > op._mesh_oflow_hwm:
+        op._mesh_oflow_hwm = rows * 2
+        from ..obs.events import recorder
+
+        ti = ctx.task_info
+        recorder.record(
+            ti.job_id, "WARN", "MESH_OVERFLOW",
+            message=(f"{rows} rows resident in the sharded aggregate's "
+                     f"per-shard HBM spill buffer (key skew past a "
+                     f"fixed-capacity exchange lane; raise "
+                     f"device.spill-capacity before it exhausts)"),
+            node=ti.node_id, subtask=ti.subtask_index,
+            data={"overflow_rows": rows})
+    return rows
+
+
 def make_window_aggregator(acc_kinds, acc_dtypes, backend: str):
     """Single-chip SlotAggregator or (device.mesh-devices > 1) the
     key-space-sharded ShardedAggregator — one construction path shared by
@@ -310,6 +338,7 @@ class TumblingAggregate(Operator):
         # in-flight closes: (ExtractHandle|None, rel_before|None, Watermark|None)
         self._pending: deque = deque()  # state: ephemeral — force-drained at every barrier (handle_checkpoint) before the snapshot
         self._batch_seq = 0  # state: ephemeral — orders in-flight closes within one incarnation; the queue is empty at every barrier
+        self._mesh_oflow_hwm = 0  # state: ephemeral — MESH_OVERFLOW event throttle high-water mark
 
     # ------------------------------------------------------------------
 
@@ -463,6 +492,40 @@ class TumblingAggregate(Operator):
         self._aggregator().update(hashes, rel, vals)
         self.open_bins.update(np.unique(rel).tolist())
 
+    def mesh_insert_begin(self, bins_abs, collector):
+        """Host half of the FUSED mesh step (engine/segment.py
+        _mesh_execute): the member's mutable-state prologue — pending-close
+        drain, base-bin anchoring, late-data split, open-bin bookkeeping —
+        WITHOUT the aggregator update, which the shard_map'd program
+        performs in-program. Returns the on-time row mask (None = every
+        row inserts). Mirrors insert_arrays statement for statement so
+        checkpoints and the late boundary stay byte-identical across the
+        fused, compiled-host, and interpreted paths."""
+        self._batch_seq += 1
+        if self._pending:
+            self._drain_pending(collector)
+        if len(bins_abs) == 0:
+            return None
+        if self.base_bin is None:
+            self.base_bin = int(bins_abs.min())
+        rel = (bins_abs - self.base_bin).astype(np.int32)
+        ontime = None
+        if self.emitted_before_rel is not None:
+            late = rel < self.emitted_before_rel
+            if late.any():
+                self.late_rows += int(late.sum())
+                ontime = ~late
+                rel = rel[ontime]
+        if len(rel):
+            self.open_bins.update(np.unique(rel).tolist())
+        return ontime
+
+    def mesh_stats(self):
+        """Mesh-execution residency counters (None off the sharded path);
+        obs/profile.py exports them as the arroyo_mesh_* series."""
+        stats = getattr(self._agg, "mesh_stats", None)
+        return stats() if stats is not None else None
+
     # ------------------------------------------------------------- emission
 
     def _drain_pending(self, collector, force: bool = False) -> None:
@@ -604,6 +667,7 @@ class TumblingAggregate(Operator):
             tbl.replace_all([])
             return
         keys, bins, accs = self._agg.snapshot()
+        record_mesh_overflow(self, ctx)
         if len(keys) == 0:
             tbl.replace_all([])
             return

@@ -776,6 +776,189 @@ def run_segment_ab() -> None:
     sys.exit(0 if all_ok else 1)
 
 
+def run_mesh_ab() -> None:
+    """--mesh-ab: fused shard_map segment vs host-shuffle mesh A/B
+    (ISSUE 20), emitting MULTICHIP_r06.json.
+
+    One pipeline — impulse -> watermark -> key -> tumbling count/sum over
+    an 8-way key-sharded aggregate -> vec sink — run two ways, paired back
+    to back per rep:
+
+      fused:  the compiled segment runs INSIDE the sharded aggregate's one
+              shard_map'd jitted program per micro-batch
+              (segment.compile.mesh-fuse on);
+      host:   the same compiled segment on host, feeding the aggregate's
+              per-batch host bucketing + device all_to_all exchange
+              (mesh-fuse off) — the pre-fusion mesh path.
+
+    Both modes' outputs are verified exactly against a closed-form oracle,
+    and the artifact embeds the dispatch ledger per mode: segment-level
+    fused dispatches MUST equal aggregate-level program executions
+    (calls_per_step == 1.0), so 'one jitted call per step' is data in the
+    artifact, not prose. Runs on 8 EMULATED host devices
+    (--xla_force_host_platform_device_count; the container's tunnel
+    exposes one real chip), so absolute ev/s is a CPU number — judge the
+    ledger and the paired ratio, not the wall clock. When fewer than 8
+    devices materialize the artifact records skipped=true and exits 0
+    (r01-r05 convention)."""
+    import tempfile
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")  # tunnel shim override
+    except Exception:
+        pass
+
+    import arroyo_tpu
+    from arroyo_tpu import config as cfg
+    from arroyo_tpu.engine import Engine
+    from arroyo_tpu.engine.segment import (mesh_dispatch_counts,
+                                           reset_mesh_dispatch_counts)
+    from arroyo_tpu.parallel import can_make
+    from arroyo_tpu.parallel.sharded_agg import (dispatch_counts,
+                                                 reset_dispatch_counts)
+
+    n_dev = 8
+    if not can_make(n_dev):
+        payload = {"n_devices": len(jax.devices()), "rc": 0, "ok": False,
+                   "skipped": True,
+                   "tail": f"mesh-ab skipped: {len(jax.devices())} devices "
+                           f"< {n_dev} (set XLA_FLAGS="
+                           f"--xla_force_host_platform_device_count=8)"}
+        with open("MULTICHIP_r06.json", "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        print(json.dumps(payload))
+        sys.exit(0)
+
+    arroyo_tpu._load_operators()
+    count, width, nkeys = int(os.environ.get("ARROYO_BENCH_EVENTS", 200_000)), 1_000_000, 7
+    reps = int(os.environ.get("ARROYO_BENCH_REPS", 3))
+    BS = 4096
+    cfg.update({
+        "checkpoint.storage-url": tempfile.mkdtemp(prefix="arroyo-mesh-ab-"),
+        "device.mesh-devices": n_dev,
+        "device.table-capacity": 8192, "device.batch-capacity": 2048,
+        "device.emit-capacity": 4096, "device.spill-capacity": 4096,
+        "device.max-probes": 32,
+        "pipeline.chaining.enabled": True,
+        "pipeline.source-batch-size": BS,
+        "engine.coalesce.max-rows": BS,
+        "segment.compile.min-rows": 1,
+    })
+
+    from arroyo_tpu.batch import TIMESTAMP_FIELD, Schema
+    from arroyo_tpu.expr import BinOp, Col, Lit
+    from arroyo_tpu.graph import EdgeType, Graph, Node, OpName
+
+    S = Schema.of([("x", "int64"), (TIMESTAMP_FIELD, "int64")])
+
+    def mk(rows):
+        g = Graph()
+        g.add_node(Node("src", OpName.SOURCE, {
+            "connector": "impulse", "message_count": count,
+            "interval_micros": 1000, "start_time_micros": 0,
+            "event_rate": 0}, 1))
+        g.add_node(Node("wm", OpName.WATERMARK, {"expr": Col(TIMESTAMP_FIELD)}, 1))
+        g.add_node(Node("key", OpName.KEY, {
+            "keys": [("k", BinOp("%", Col("counter"), Lit(nkeys)))]}, 1))
+        g.add_node(Node("agg", OpName.TUMBLING_AGGREGATE, {
+            "width_micros": width, "key_fields": ["k"],
+            "aggregates": [("cnt", "count", None),
+                           ("total", "sum", Col("counter"))],
+            "input_dtype_of": lambda e: np.dtype(np.int64),
+            "backend": "jax"}, 1))
+        g.add_node(Node("sink", OpName.SINK, {"connector": "vec", "rows": rows}, 1))
+        g.add_edge("src", "wm", EdgeType.FORWARD, S)
+        g.add_edge("wm", "key", EdgeType.FORWARD, S)
+        g.add_edge("key", "agg", EdgeType.SHUFFLE, S)
+        g.add_edge("agg", "sink", EdgeType.FORWARD, S)
+        return g
+
+    want: dict = {}
+    for c in range(count):
+        w, k = (c * 1000) // width, c % nkeys
+        cnt, tot = want.get((w, k), (0, 0))
+        want[(w, k)] = (cnt + 1, tot + c)
+
+    def one(fuse: bool, tag: str):
+        cfg.update({"segment.compile.mesh-fuse": fuse})
+        reset_mesh_dispatch_counts()
+        reset_dispatch_counts()
+        rows: list = []
+        gc.collect()
+        eng = Engine(mk(rows), job_id=f"mesh-ab-{tag}")
+        t0 = time.perf_counter()
+        eng.run_to_completion(timeout=600)
+        wall = time.perf_counter() - t0
+        got = {(r["window_start"] // width, r["k"]): (r["cnt"], r["total"])
+               for r in rows}
+        assert got == want, f"mesh-ab {tag}: output diverged from oracle"
+        return count / wall, mesh_dispatch_counts(), dispatch_counts()
+
+    # warmup both modes: XLA program compiles + segment cache entries
+    # (including the remainder-batch shape) happen here, not mid-rep
+    one(False, "warm-host")
+    one(True, "warm-fused")
+
+    modes: dict = {"fused": {}, "host": {}}
+    ratios: list[float] = []
+    ledger_ok = True
+    for r in range(reps):
+        eps_h, _seg_h, agg_h = one(False, f"host-{r}")
+        eps_f, seg_f, agg_f = one(True, f"fused-{r}")
+        ratios.append(eps_f / eps_h)
+        # the tentpole's proof obligation: every fused segment dispatch is
+        # exactly one program execution, and the fused path actually ran
+        cps = (agg_f["fused_steps"] / seg_f["fused"]) if seg_f["fused"] else 0.0
+        ledger_ok = ledger_ok and seg_f["fused"] > 0 and cps == 1.0 \
+            and agg_h["fused_steps"] == 0 and agg_h["host_steps"] > 0
+        print(f"# mesh-ab pair {r}: host {eps_h:,.0f} ev/s, fused "
+              f"{eps_f:,.0f} ev/s, ratio {eps_f / eps_h:.3f}, fused "
+              f"dispatches {seg_f['fused']} (calls/step {cps:.1f})",
+              file=sys.stderr)
+        if eps_h > modes["host"].get("events_per_sec", 0):
+            modes["host"] = {"events_per_sec": round(eps_h, 1),
+                             "dispatch": {"segment_fused": 0,
+                                          "agg_program_steps": agg_h["fused_steps"],
+                                          "agg_host_exchange_steps": agg_h["host_steps"]}}
+        if eps_f > modes["fused"].get("events_per_sec", 0):
+            modes["fused"] = {"events_per_sec": round(eps_f, 1),
+                              "dispatch": {"segment_fused": seg_f["fused"],
+                                           "segment_host_commits": seg_f["host"],
+                                           "agg_program_steps": agg_f["fused_steps"],
+                                           "agg_host_exchange_steps": agg_f["host_steps"],
+                                           "calls_per_step": round(cps, 3)}}
+    best, median = max(ratios), statistics.median(ratios)
+    ok = ledger_ok and best >= 1.0
+    tail = (f"mesh-ab OK: 8 devices, fused/host best {best:.3f} (median "
+            f"{median:.3f}), {modes['fused']['dispatch']['segment_fused']} "
+            f"fused steps at calls/step "
+            f"{modes['fused']['dispatch']['calls_per_step']:.1f}, oracle "
+            f"exact both modes" if ok else
+            f"mesh-ab REGRESSION: ratio best {best:.3f} median {median:.3f} "
+            f"ledger_ok={ledger_ok}")
+    payload = {
+        "n_devices": n_dev, "rc": 0 if ok else 1, "ok": ok, "skipped": False,
+        "tail": tail,
+        "metric": "mesh_fused_over_host_events_per_sec",
+        "value": round(best, 3),
+        "unit": "fused/host events-per-sec ratio, best of paired reps on 8 "
+                "emulated CPU devices (ledger proves one jitted program "
+                "execution per fused micro-batch)",
+        "extra": {"events": count, "reps": reps,
+                  "pair_ratios": [round(x, 3) for x in ratios],
+                  "pair_ratio_median": round(median, 3),
+                  "one_call_per_step": ledger_ok, **modes},
+    }
+    with open("MULTICHIP_r06.json", "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(json.dumps(payload))
+    sys.exit(0 if ok else 1)
+
+
 def _probe_default_platform(attempts: int = 4, retry_delay_s: float = 30.0) -> str:
     """Platform kind ("tpu"/"cpu"/...) when the default jax platform (the
     TPU tunnel under the driver) initializes AND can run a computation, or
@@ -816,6 +999,16 @@ def main() -> None:
     if "--load-ramp" in sys.argv[1:]:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         run_load_ramp()
+        return
+    if "--mesh-ab" in sys.argv[1:]:
+        # fused shard_map segment A/B on 8 emulated host devices: force
+        # the flags BEFORE any backend init (jax reads XLA_FLAGS once)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        _fl = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in _fl:
+            os.environ["XLA_FLAGS"] = (
+                _fl + " --xla_force_host_platform_device_count=8").strip()
+        run_mesh_ab()
         return
     if "--segment-compile-ab" in sys.argv[1:]:
         # whole-segment compilation A/B: the win being measured is the

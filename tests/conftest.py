@@ -60,6 +60,9 @@ def pytest_configure(config):
                    "failures and asserts byte-exact recovery)")
     config.addinivalue_line(
         "markers", "slow: long soak tests excluded from the tier-1 budget")
+    config.addinivalue_line(
+        "markers", "mesh: multi-device mesh execution suite (8 emulated "
+                   "devices; tools/lint.sh --mesh-tests runs just these)")
 
 
 @pytest.hookimpl(hookwrapper=True)

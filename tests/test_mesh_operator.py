@@ -16,6 +16,8 @@ from arroyo_tpu.hashing import hash_column
 
 from test_tumbling import expected_counts, windowed_count_graph
 
+pytestmark = pytest.mark.mesh
+
 
 def _mesh_devices():
     import jax

@@ -332,7 +332,7 @@ def test_untraceable_udaf_window_stops_prefix():
             "aggregates": [("d", "count_distinct", Col("auction"))]}),
     ]
     marking = segment_marking(members)
-    assert marking == {"prefix": 3, "insert": False,
+    assert marking == {"prefix": 3, "insert": False, "mesh": False,
                        "stop": "window: count_distinct accumulator is "
                                "host-resident"}
 

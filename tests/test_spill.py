@@ -19,6 +19,7 @@ Three layers:
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -614,6 +615,17 @@ def test_chaos_worker_crash_mid_checkpoint_with_spilled_state(tmp_path, _storage
         eng = ts.build(sql, 2, job)
         eng.start()
         assert eng.checkpoint_and_wait(1, timeout=60), "epoch 1"
+        # barrier 2 crashes the SOURCE before the barrier ever reaches the
+        # aggregate, so teardown races the aggregate still chewing the
+        # pre-gate half of the input in the background. Wait for spill to
+        # provably engage (SPILL_STARTED fires only after run files hit
+        # disk) before arming the crash epoch — "runs on disk at crash
+        # time" must be a guarantee, not a scheduling accident.
+        deadline = time.monotonic() + 60
+        while not any(e["code"] == "SPILL_STARTED"
+                      for e in recorder.events(job)):
+            assert time.monotonic() < deadline, "spill never engaged"
+            time.sleep(0.05)
         with pytest.raises(RuntimeError, match="injected"):
             if eng.checkpoint_and_wait(2, timeout=60):
                 raise AssertionError("epoch 2 completed despite the crash")

@@ -897,3 +897,60 @@ def test_runner_for_copies_reject_reason():
     m = TaskMetrics("j", "n", 0)
     assert runner_for(op, None, m) is None
     assert m.segment_reason == "not compilable: fixture reason"
+
+
+# ============================================= shard_map roots (mesh fusion)
+
+
+def test_shard_map_is_a_jit_root():
+    """A function handed to shard_map runs traced per-shard even when no
+    jit() call wraps it in the same module (engine/segment.py jits the
+    composed program elsewhere) — the walker must treat the shard_map call
+    site as a root or the fused mesh step escapes LR301-LR305 entirely."""
+    src = _PINNED + '''
+import jax
+from jax.experimental.shard_map import shard_map
+
+def build(mesh, specs):
+    def step(state, x):
+        float(x)                        # host sync on traced
+        return state, x
+    return shard_map(step, mesh, in_specs=specs, out_specs=specs)
+'''
+    diags = audit_trace_source(src, "engine/fixture.py")
+    assert ids_of(diags) == {"LR301"}
+
+
+def test_shard_map_compat_alias_is_a_jit_root():
+    """The repo's version-compat alias (parallel/sharded_agg.py imports it
+    as ``_shard_map``) must not dodge root discovery: leading underscores
+    are stripped before the name check."""
+    src = _PINNED + '''
+import jax
+from jax.experimental.shard_map import shard_map as _shard_map
+
+def build(mesh, specs):
+    def step(state, x):
+        if x > 0:                       # if on traced
+            pass
+        return state, x
+    return jax.jit(_shard_map(step, mesh, in_specs=specs, out_specs=specs))
+'''
+    diags = audit_trace_source(src, "engine/fixture.py")
+    assert ids_of(diags) == {"LR301"}
+
+
+def test_shard_map_clean_body_is_clean():
+    """Negative control: a pure per-shard body through the same wrapper
+    produces no findings (the root is walked, and passes)."""
+    src = _PINNED + '''
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+
+def build(mesh, specs):
+    def step(state, x):
+        return state, jnp.where(x > 0, x, 0)
+    return shard_map(step, mesh, in_specs=specs, out_specs=specs)
+'''
+    assert audit_trace_source(src, "engine/fixture.py") == []

@@ -15,6 +15,10 @@
 #                              (obs/events.py EVENT_CODES, cross-checked
 #                              against code-site literals) is documented in
 #                              the README "Events & health" table
+#   tools/lint.sh --mesh-tests
+#                              run the tier-1 `mesh`-marked pytest subset
+#                              on 8 emulated host devices (the fused
+#                              shard_map segment path; same flag CI uses)
 #   tools/lint.sh --rules-catalog
 #                              assert every LR/AR rule id registered in the
 #                              analysis engines (repo_lint.RULES,
@@ -66,6 +70,7 @@ NAME_RE = re.compile(r"arroyo_(?:worker|checkpoint)_[a-z0-9_]+"
                      r"|arroyo_spill_[a-z0-9_]+"
                      r"|arroyo_fleet_[a-z0-9_]+"
                      r"|arroyo_bad_records_total"
+                     r"|arroyo_mesh_[a-z0-9_]+"
                      r"|arroyo_events_total")
 code_names: set[str] = set()
 for p in glob.glob("arroyo_tpu/**/*.py", recursive=True):
@@ -169,6 +174,13 @@ if missing:
     sys.exit(1)
 print(f"rules-catalog: ok ({len(rule_ids)} rule ids documented)")
 EOF
+fi
+
+if [[ "${1:-}" == "--mesh-tests" ]]; then
+    # tests/conftest.py forces the same flag before backend init, but
+    # setting it here keeps the subset honest when invoked standalone
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        python -m pytest tests -q -m mesh -p no:cacheprovider
 fi
 
 if [[ "${1:-}" == "--check" ]]; then
